@@ -1,0 +1,105 @@
+"""Headline benchmark: flagship training throughput on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: GPT-2-small causal-LM training throughput (tokens/sec) at batch 8 ×
+seq 512 — driver config #1 ("GPT-2-small on WikiText-103, single job, 1
+device", BASELINE.md). The reference publishes no in-tree numbers
+(SURVEY.md §6), so the baseline is self-measured: the first recorded run's
+value is stored in ``bench_baseline.json`` and later runs report
+``vs_baseline = value / baseline`` (>1 is faster).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import timeit
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    batch_size, seq_len = 8, 512
+    spec = build_gpt2("gpt2-small", seq_len=seq_len)
+    ds = make_lm_dataset(
+        context_length=seq_len,
+        batch_size=batch_size,
+        vocab_size=spec.config.vocab_size,
+        n_tokens=seq_len * batch_size * 16,
+    )
+    tx = optax.adamw(3e-4)
+
+    def init_state():
+        params = spec.init_fn(jax.random.PRNGKey(0))
+        return {"params": params, "opt_state": tx.init(params)}
+
+    def train_step(state, batch):
+        def loss_of(p):
+            return pretraining_loss(spec.apply_fn(p, batch), batch)
+
+        loss, grads = jax.value_and_grad(loss_of)(state["params"])
+        updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return {"params": new_params, "opt_state": new_opt}, loss
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    state = jax.jit(init_state)()
+    batches = [jnp.asarray(ds.batch(i)) for i in range(8)]
+
+    # compile + warmup (excluded from timing; SURVEY.md §7 "honest profiling").
+    # Sync via host read of the loss: block_until_ready on the tunneled TPU
+    # platform can return before queued steps drain (see utils/timing.py).
+    for _ in range(3):
+        state, loss = step(state, batches[0])
+    float(jax.device_get(loss))
+
+    n_timed = 20
+    t0 = timeit.default_timer()
+    for i in range(n_timed):
+        state, loss = step(state, batches[i % len(batches)])
+    float(jax.device_get(loss))
+    dt = (timeit.default_timer() - t0) / n_timed
+
+    tokens_per_sec = batch_size * seq_len / dt
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    platform = jax.devices()[0].platform
+    key = f"gpt2s_train_tokens_per_sec_{platform}"
+    baseline = None
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            baseline = json.load(f).get(key)
+    if baseline is None:
+        baseline = tokens_per_sec  # first run defines the baseline
+        try:
+            data = {}
+            if os.path.exists(base_path):
+                with open(base_path) as f:
+                    data = json.load(f)
+            data[key] = tokens_per_sec
+            with open(base_path, "w") as f:
+                json.dump(data, f, indent=1)
+        except OSError:
+            pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2s_train_tokens_per_sec",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(tokens_per_sec / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""saturn_tpu: a TPU-native multi-model training orchestrator.
+
+A brand-new JAX/XLA/pjit framework with the capabilities of knagrecha/saturn
+(the SPASE multi-query optimizer: Select Parallelism, Apportion resources,
+SchedulE). Public API mirrors the reference's four calls (SURVEY.md §0):
+
+1. ``saturn_tpu.library.register(name, technique_cls)``
+2. ``saturn_tpu.search(tasks)``           — profile (task × sub-mesh × technique)
+3. ``saturn_tpu.orchestrate(task_list)``  — solve + gang-execute to completion
+4. ``Task`` / ``HParams`` / ``Strategy``  — job description dataclasses
+"""
+
+from saturn_tpu.core.strategy import Strategy, Techniques
+from saturn_tpu.core.task import HParams, Task
+from saturn_tpu.core.technique import BaseTechnique
+from saturn_tpu.core.modelspec import ModelSpec
+from saturn_tpu import library
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Task",
+    "HParams",
+    "Strategy",
+    "Techniques",
+    "BaseTechnique",
+    "ModelSpec",
+    "library",
+    "search",
+    "orchestrate",
+]
+
+
+def search(tasks, technique_names=None, log=False, topology=None):
+    """Profile every (task × sub-mesh size × technique) combination.
+
+    Reference: ``saturn/trial_runner/PerformanceEvaluator.py:33``.
+    """
+    from saturn_tpu.trial_runner.evaluator import search as _search
+
+    return _search(tasks, technique_names=technique_names, log=log, topology=topology)
+
+
+def orchestrate(task_list, log=False, interval=1000, topology=None, **kw):
+    """Solve the SPASE problem and run the batch to completion.
+
+    Reference: ``saturn/orchestrator.py:32``.
+    """
+    from saturn_tpu.executor.orchestrator import orchestrate as _orch
+
+    return _orch(task_list, log=log, interval=interval, topology=topology, **kw)

@@ -1,0 +1,125 @@
+"""Contiguous ICI sub-mesh topology and allocation.
+
+This is the TPU-native replacement for the reference's flat-GPU resource model.
+The reference allocated integer GPU counts on a node (``milp.py:184-227``) and
+relied on Ray's GPU bookkeeping for placement (``executor.py:59-62``). On a TPU
+pod slice, the resource is a **contiguous sub-mesh**: a set of chips that are
+neighbors on the ICI torus, so that XLA collectives ride ICI instead of DCN.
+
+We model the slice as a flat ring of ``N`` devices (JAX's default device order
+is a space-filling order over the physical torus, so contiguous, size-aligned
+index ranges correspond to physically compact sub-slices). Allocation is
+**buddy-style**: sub-mesh sizes are powers of two and a block of size ``s`` must
+start at an offset that is a multiple of ``s``. This guarantees (a) two blocks
+either nest or are disjoint, and (b) every block is contiguous on the ring —
+which is exactly the property the MILP needs for its non-overlap constraints.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+@dataclass(frozen=True)
+class Block:
+    """A contiguous, size-aligned run of devices: the allocatable unit."""
+
+    offset: int
+    size: int
+
+    def __post_init__(self) -> None:
+        if not _is_pow2(self.size):
+            raise ValueError(f"block size must be a power of two, got {self.size}")
+        if self.offset % self.size != 0:
+            raise ValueError(
+                f"block offset {self.offset} not aligned to size {self.size}"
+            )
+
+    @property
+    def end(self) -> int:
+        return self.offset + self.size
+
+    def overlaps(self, other: "Block") -> bool:
+        return self.offset < other.end and other.offset < self.end
+
+    def devices_of(self, devices: Sequence[Any]) -> List[Any]:
+        return list(devices[self.offset : self.end])
+
+
+class SliceTopology:
+    """The pod slice the scheduler allocates from.
+
+    Replaces the reference's ``ray.nodes()`` GPU discovery (``milp.py:53-62``,
+    including its hardcoded ``DEBUG=True`` 8-GPUs-per-node stub — we take an
+    explicit device list instead).
+    """
+
+    def __init__(self, devices: Optional[Sequence[Any]] = None):
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        self.devices: List[Any] = list(devices)
+        n = len(self.devices)
+        # Usable capacity is the largest power of two <= N so buddy allocation
+        # is well-formed even on odd-sized device sets (e.g. CPU test meshes).
+        self.capacity = 1 << (n.bit_length() - 1)
+
+    def valid_sizes(self, max_size: Optional[int] = None) -> List[int]:
+        """All allocatable sub-mesh sizes: powers of two up to capacity."""
+        cap = self.capacity if max_size is None else min(max_size, self.capacity)
+        out, s = [], 1
+        while s <= cap:
+            out.append(s)
+            s <<= 1
+        return out
+
+    def blocks(self, size: int) -> List[Block]:
+        """All aligned blocks of a given size (the MILP's placement domain)."""
+        if size not in self.valid_sizes():
+            raise ValueError(f"invalid sub-mesh size {size} for capacity {self.capacity}")
+        return [Block(off, size) for off in range(0, self.capacity, size)]
+
+    def block_devices(self, block: Block) -> List[Any]:
+        return block.devices_of(self.devices)
+
+
+def make_submesh(
+    devices: Sequence[Any],
+    axis_names: Tuple[str, ...],
+    axis_sizes: Optional[Tuple[int, ...]] = None,
+):
+    """Build a ``jax.sharding.Mesh`` over a contiguous device block.
+
+    This is the TPU analog of the reference's NCCL process-group formation
+    (``FSDP.py:44-50``): where the reference rendezvoused worker processes into
+    a communicator, we reshape a contiguous device block into a logical mesh
+    whose axes carry the parallelism (data / model / stage / seq).
+
+    ``axis_sizes`` must multiply to ``len(devices)``; a single ``-1`` entry is
+    inferred. Default: one axis spanning all devices.
+    """
+    from jax.sharding import Mesh
+
+    devs = np.asarray(list(devices), dtype=object)
+    n = devs.size
+    if axis_sizes is None:
+        axis_sizes = tuple([n] + [1] * (len(axis_names) - 1))
+    sizes = list(axis_sizes)
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
+        if n % known != 0:
+            raise ValueError(f"cannot infer axis: {n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"axis sizes {sizes} do not multiply to {n} devices")
+    return Mesh(devs.reshape(sizes), axis_names)

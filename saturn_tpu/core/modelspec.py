@@ -1,0 +1,42 @@
+"""ModelSpec: the functional model contract techniques consume.
+
+The reference's ``Task.get_model`` returned an ``nn.Sequential`` torch module
+(``GPTJ.py:502-526`` flattens GPT-J into a Sequential precisely so GPipe /
+OffloadModel can partition it). The TPU-native analog is a *functional* spec:
+pure ``init``/``apply`` functions plus a config that exposes the structure
+techniques need (layer count for pipeline balancing, hints for remat and
+tensor-parallel rules). Params are a plain pytree, so every technique shards
+the same arrays with its own ``PartitionSpec`` rules — no wrapper classes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+@dataclass
+class ModelSpec:
+    """Functional model bundle returned by a task's ``get_model`` factory.
+
+    - ``init_fn(rng) -> params``: build (host or device) params.
+    - ``apply_fn(params, inputs) -> logits``: pure forward pass, jit-safe.
+    - ``abstract_init() -> params_shapes``: ``jax.eval_shape`` of ``init_fn`` —
+      lets the trial runner do memory analysis without materializing weights
+      (honoring the reference's lazy-instantiation rule, ``Task.py:92-97``).
+    - ``config``: model hyperparams; must expose ``n_layers`` and example input
+      shapes via ``example_inputs`` for tracing.
+    - ``hints``: free-form dict mirroring the reference's transformer hints
+      (``Task.py:121-124``), e.g. ``{"block_param_key": "blocks"}`` telling
+      pipeline/FSDP executors where the scanned layer stack lives.
+    """
+
+    init_fn: Callable[[Any], Any]
+    apply_fn: Callable[[Any, Any], Any]
+    config: Any
+    hints: Dict[str, Any] = field(default_factory=dict)
+
+    def abstract_init(self):
+        import jax
+
+        return jax.eval_shape(self.init_fn, jax.random.PRNGKey(0))

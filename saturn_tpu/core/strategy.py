@@ -1,0 +1,59 @@
+"""Strategy: the (technique, sub-mesh size, params, runtime) tuple the solver picks.
+
+TPU-native analog of the reference's ``saturn/core/representations/Strategy.py:50-76``.
+Differences from the reference (intentional, idiomatic-TPU):
+
+- The allocation unit is a **contiguous ICI sub-mesh size** (power-of-two number of
+  chips of the pod slice), not a flat GPU count. The solver later picks *which*
+  aligned block of that size the job runs on (buddy-style allocation preserves ICI
+  contiguity on the torus).
+- ``Techniques`` lists the techniques the built-in library actually ships. The
+  reference declared ``MEGATRON = 4`` but never implemented it
+  (``Strategy.py:34``); here tensor parallelism is a real executor.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+class Techniques(enum.Enum):
+    """Built-in parallelism techniques (reference: ``Strategy.py:25-34``)."""
+
+    DP = 1          # batch-sharded pjit over a 1-D `data` mesh axis
+    FSDP = 2        # GSPMD fully-sharded params (ZeRO-3 style)
+    PIPELINE = 3    # stage-sharded layers, microbatched (GPipe-style)
+    OFFLOAD = 4     # host-memory param/activation offload ("spilling")
+    TENSOR = 5      # Megatron-style tensor parallelism over a `model` axis
+    RING = 6        # sequence/context parallelism with ring attention
+
+
+@dataclass
+class Strategy:
+    """One profiled execution option for a task.
+
+    Reference: ``Strategy.py:50-73`` — (executor, gpu_apportionment, params,
+    runtime). Here ``apportionment`` is the number of chips in the contiguous
+    sub-mesh; ``params`` are the technique's autotuned knobs returned by
+    ``BaseTechnique.search``; ``runtime`` is the estimated *remaining* runtime in
+    seconds for the task under this strategy (decremented by the forecast loop as
+    batches complete — reference ``executor.py:165-172``).
+    """
+
+    executor: Any                      # BaseTechnique instance (or None = dummy)
+    apportionment: int                 # number of chips (power of two)
+    params: Optional[Dict[str, Any]]   # autotuned knobs; None = infeasible
+    runtime: float                     # est. remaining runtime, seconds
+    per_batch_time: float = field(default=0.0)  # seconds per batch (profiled)
+
+    def __post_init__(self) -> None:
+        if self.apportionment < 1:
+            raise ValueError("apportionment must be a positive chip count")
+
+    @property
+    def feasible(self) -> bool:
+        """Reference treats params=None as an un-runnable strategy
+        (``PerformanceEvaluator.py:96-99,110``)."""
+        return self.params is not None and self.executor is not None

@@ -1,0 +1,106 @@
+"""Language-model datasets: one token stream, chunked into fixed-length batches.
+
+Parity target: ``examples/wikitext103/dataloaders/dataloaders.py:22-84`` —
+tokenize a corpus into a single token stream, cache it, slice into
+``context_length`` chunks, and serve (input, label) pairs where label == input
+(the loss does the shift). TPU-native deltas:
+
+- Batches are dense numpy int32 arrays with **static shapes** (XLA requirement).
+- ``batch(i)`` is O(1) random access, fixing the reference's O(position)
+  iterator fast-forward on resume (``Task.py:138-139``).
+- With no network access, the default corpus is a deterministic synthetic
+  Zipf-distributed token stream; a local text file can be supplied and is
+  byte-tokenized and cached as ``.npz`` exactly like the reference's cache
+  (``dataloaders.py:70-84``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class TokenDataset:
+    """Fixed-shape LM batches over one token stream."""
+
+    def __init__(
+        self,
+        tokens: np.ndarray,
+        context_length: int = 512,
+        batch_size: int = 8,
+    ):
+        tokens = np.asarray(tokens, dtype=np.int32)
+        self.context_length = context_length
+        self.batch_size = batch_size
+        n_chunks = len(tokens) // context_length
+        if n_chunks < batch_size:
+            raise ValueError(
+                f"corpus too small: {n_chunks} chunks < batch_size {batch_size}"
+            )
+        self._chunks = tokens[: n_chunks * context_length].reshape(
+            n_chunks, context_length
+        )
+        self._n_batches = n_chunks // batch_size
+
+    def __len__(self) -> int:
+        """Batches per epoch (reference ``Task.py:127`` epoch_length)."""
+        return self._n_batches
+
+    def batch(self, i: int) -> np.ndarray:
+        """(batch_size, context_length) int32 tokens for batch index ``i``."""
+        i = i % self._n_batches
+        return self._chunks[i * self.batch_size : (i + 1) * self.batch_size]
+
+    def example_batch(self) -> np.ndarray:
+        return np.zeros((self.batch_size, self.context_length), dtype=np.int32)
+
+
+def synthetic_tokens(
+    n_tokens: int, vocab_size: int, seed: int = 0, zipf_a: float = 1.2
+) -> np.ndarray:
+    """Deterministic Zipf-ish token stream — realistic rank-frequency shape so
+    embedding-gather and softmax behave like natural text."""
+    rng = np.random.default_rng(seed)
+    ranks = rng.zipf(zipf_a, size=n_tokens)
+    return (ranks % vocab_size).astype(np.int32)
+
+
+def byte_tokenize_file(path: str, cache_dir: str = ".saturn_data_cache") -> np.ndarray:
+    """Byte-level tokenization of a local text file, cached as .npz
+    (cache scheme parity with ``dataloaders.py:70-84``)."""
+    os.makedirs(cache_dir, exist_ok=True)
+    key = hashlib.sha1(os.path.abspath(path).encode()).hexdigest()[:16]
+    cache = os.path.join(cache_dir, f"bytes_{key}.npz")
+    if os.path.exists(cache):
+        with np.load(cache) as z:
+            return z["tokens"]
+    with open(path, "rb") as f:
+        tokens = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int32)
+    np.savez(cache, tokens=tokens)
+    return tokens
+
+
+def make_lm_dataset(
+    context_length: int = 512,
+    batch_size: int = 8,
+    vocab_size: int = 50304,
+    n_tokens: Optional[int] = None,
+    corpus_path: Optional[str] = None,
+    seed: int = 0,
+) -> TokenDataset:
+    """Dataloader factory for ``Task(get_dataloader=...)``.
+
+    Uses ``corpus_path`` (byte-tokenized local file, vocab must be >= 256) if
+    given and present, else a synthetic stream of ``n_tokens`` tokens
+    (default: enough for 64 batches).
+    """
+    if corpus_path and os.path.exists(corpus_path):
+        tokens = byte_tokenize_file(corpus_path)
+    else:
+        if n_tokens is None:
+            n_tokens = context_length * batch_size * 64
+        tokens = synthetic_tokens(n_tokens, vocab_size, seed=seed)
+    return TokenDataset(tokens, context_length=context_length, batch_size=batch_size)

@@ -1,0 +1,118 @@
+"""Technique library: register / deregister / retrieve parallelism plugins.
+
+Reference: ``saturn/library/library.py:19-73``, which dill-serialized UDP
+classes to ``$SATURN_LIBRARY_PATH/<name>.udp`` so they could cross Ray worker
+process boundaries by value. Our control plane is single-process (threads on
+the pod host — SURVEY.md §5 "Ray is unnecessary"), so the primary registry is
+an in-process dict; dill persistence to ``$SATURN_TPU_LIBRARY_PATH`` is kept as
+an *optional* compatibility layer so user-defined techniques survive across
+driver processes exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Type, Union
+
+from saturn_tpu.core.technique import BaseTechnique
+
+_REGISTRY: Dict[str, Type[BaseTechnique]] = {}
+
+_ENV_PATH = "SATURN_TPU_LIBRARY_PATH"
+
+
+def _persist_dir() -> Optional[str]:
+    return os.environ.get(_ENV_PATH)
+
+
+def register(name: str, technique_cls: Type[BaseTechnique]) -> None:
+    """Register a technique class under ``name`` (reference ``library.py:19-35``).
+
+    Type-checks the BaseTechnique contract like the reference's issubclass
+    check (``library.py:28``); persists via dill only if the env path is set.
+    """
+    if not (isinstance(technique_cls, type) and issubclass(technique_cls, BaseTechnique)):
+        raise TypeError(
+            f"{technique_cls!r} is not a subclass of BaseTechnique; "
+            "techniques must implement search() and execute()"
+        )
+    _REGISTRY[name] = technique_cls
+    d = _persist_dir()
+    if d:
+        import dill
+
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"{name}.udp"), "wb") as f:
+            dill.dump(technique_cls, f)
+
+
+def deregister(name: str) -> None:
+    """Remove a technique (reference ``library.py:38-49``)."""
+    _REGISTRY.pop(name, None)
+    d = _persist_dir()
+    if d:
+        p = os.path.join(d, f"{name}.udp")
+        if os.path.exists(p):
+            os.unlink(p)
+
+
+def retrieve(
+    names: Union[None, str, List[str]] = None,
+) -> Union[Type[BaseTechnique], List[Type[BaseTechnique]]]:
+    """Fetch one / several / all registered techniques (``library.py:52-73``).
+
+    ``None`` returns all (insertion order); a string returns one class; a list
+    returns a list of classes. Falls back to the dill store for names not in
+    the in-process registry.
+    """
+    if names is None:
+        _load_persisted_missing()
+        return list(_REGISTRY.values())
+    if isinstance(names, str):
+        return _retrieve_one(names)
+    return [_retrieve_one(n) for n in names]
+
+
+def registered_names() -> List[str]:
+    _load_persisted_missing()
+    return list(_REGISTRY.keys())
+
+
+def _retrieve_one(name: str) -> Type[BaseTechnique]:
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    d = _persist_dir()
+    if d:
+        p = os.path.join(d, f"{name}.udp")
+        if os.path.exists(p):
+            import dill
+
+            with open(p, "rb") as f:
+                cls = dill.load(f)
+            _REGISTRY[name] = cls
+            return cls
+    raise KeyError(f"no technique registered under {name!r}")
+
+
+def _load_persisted_missing() -> None:
+    d = _persist_dir()
+    if not d or not os.path.isdir(d):
+        return
+    for fn in os.listdir(d):
+        if fn.endswith(".udp"):
+            name = fn[: -len(".udp")]
+            if name not in _REGISTRY:
+                try:
+                    _retrieve_one(name)
+                except Exception:
+                    pass
+
+
+def register_default_library() -> List[str]:
+    """Register the built-in executors (the 'default library' the reference's
+    CONTRIBUTING.md invites but never ships — SURVEY.md §1)."""
+    from saturn_tpu.parallel import BUILTIN_TECHNIQUES
+
+    for name, cls in BUILTIN_TECHNIQUES.items():
+        register(name, cls)
+    return list(BUILTIN_TECHNIQUES.keys())

@@ -1,0 +1,21 @@
+"""Pretraining losses (reference: ``GPTJ.py:491-499`` shifted cross-entropy)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def pretraining_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross-entropy: logits[:, :-1] predict tokens[:, 1:].
+
+    Mirrors the reference's shift-and-flatten CE (``GPTJ.py:491-499``) where
+    input and label are the same token batch (``dataloaders.py:22-24``).
+    """
+    shifted_logits = logits[:, :-1, :]
+    shifted_labels = tokens[:, 1:]
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        shifted_logits, shifted_labels
+    )
+    return ce.mean()

@@ -1,0 +1,40 @@
+"""Built-in parallelism technique library.
+
+The reference shipped its techniques as example UDPs outside the core
+(``examples/wikitext103/executors/``) and CONTRIBUTING.md invited a "default
+library" contribution (SURVEY.md §1). Here the default library is real:
+import-and-register via ``saturn_tpu.library.register_default_library()``.
+"""
+
+from __future__ import annotations
+
+from saturn_tpu.parallel.dp import DataParallel
+from saturn_tpu.parallel.fsdp import FSDP
+from saturn_tpu.parallel.tp import TensorParallel
+
+BUILTIN_TECHNIQUES = {
+    "dp": DataParallel,
+    "fsdp": FSDP,
+    "tp": TensorParallel,
+}
+
+try:  # executors with extra requirements register themselves if importable
+    from saturn_tpu.parallel.pp import Pipeline
+
+    BUILTIN_TECHNIQUES["pp"] = Pipeline
+except ImportError:  # pragma: no cover
+    pass
+
+try:
+    from saturn_tpu.parallel.offload import HostOffload
+
+    BUILTIN_TECHNIQUES["offload"] = HostOffload
+except ImportError:  # pragma: no cover
+    pass
+
+try:
+    from saturn_tpu.parallel.ring import RingSequenceParallel
+
+    BUILTIN_TECHNIQUES["ring"] = RingSequenceParallel
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,30 @@
+"""Data-parallel executor: batch-sharded pjit over a 1-D ``data`` mesh.
+
+Replaces the reference's DDP UDP (``examples/wikitext103/executors/DDP.py``):
+instead of per-GPU processes + NCCL allreduce, the batch is sharded over the
+``data`` axis and XLA emits the gradient psum over ICI. Unlike the reference's
+DDP — whose ``search`` returned None and could never be selected
+(``DDP.py:72``, SURVEY.md §2 C17) — this one is a first-class citizen.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from saturn_tpu.parallel import sharding as shr
+from saturn_tpu.parallel.spmd_base import SPMDTechnique
+
+
+class DataParallel(SPMDTechnique):
+    name = "dp"
+
+    def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+        return ("data",), (n_devices,)
+
+    def param_rules(self, task, config):
+        return shr.replicated_rules
+
+    def candidate_configs(self, task, n_devices) -> List[Dict[str, Any]]:
+        # remat off first (faster when it fits), on as fallback — same
+        # best-guess-first grid ordering idea as ``FSDP.py:72-78``.
+        return [{"remat": False}, {"remat": True}]

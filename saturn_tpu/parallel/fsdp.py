@@ -1,0 +1,53 @@
+"""FSDP executor: GSPMD fully-sharded params over the ``data`` axis.
+
+Replaces the reference's torch-FSDP UDP (``FSDP.py:57-245``). Where torch FSDP
+wraps modules and manually all-gathers flat params, here every param's largest
+dim is sharded over ``data`` (ZeRO-3) and XLA emits the all-gather before use
+and reduce-scatter on grads. The autotune grid mirrors the reference's
+{activation checkpointing} × {CPU offload} search (``FSDP.py:72-78``): remat
+toggles block rematerialization, offload moves persistent state to host
+memory ('pinned_host') where the platform supports it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from saturn_tpu.parallel import sharding as shr
+from saturn_tpu.parallel.spmd_base import SPMDTechnique
+
+
+def host_offload_supported() -> bool:
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+        return "pinned_host" in kinds
+    except Exception:
+        return False
+
+
+class FSDP(SPMDTechnique):
+    name = "fsdp"
+
+    def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+        return ("data",), (n_devices,)
+
+    def param_rules(self, task, config):
+        return shr.fsdp_rules(axis="data")
+
+    def param_memory_kind(self, config) -> Optional[str]:
+        return "pinned_host" if config.get("offload") else None
+
+    def candidate_configs(self, task, n_devices) -> List[Dict[str, Any]]:
+        grid: List[Dict[str, Any]] = [
+            {"remat": False, "offload": False},
+            {"remat": True, "offload": False},
+        ]
+        if host_offload_supported():
+            grid += [
+                {"remat": True, "offload": True},
+                {"remat": False, "offload": True},
+            ]
+        return grid

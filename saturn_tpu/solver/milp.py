@@ -1,0 +1,289 @@
+"""The SPASE MILP: jointly select strategy, allocate a sub-mesh, and schedule.
+
+Reference: ``saturn/solver/milp.py:23-445``. Same decision structure —
+one strategy per task (``bss``, ``milp.py:96-111``), one placement per task
+(``bna`` node choice, ``:117-137``), start times (``sta``, ``:139-149``),
+pairwise ordering (``boa``, ``:263-270``), makespan objective (``:90,321``) —
+re-shaped for a TPU pod slice:
+
+- Placement ranges over **contiguous, size-aligned blocks** of the device ring
+  (buddy allocation; see ``core/mesh.py``) instead of (node × GPU-subset).
+  The reference's "a job never spans nodes" constraint (``milp.py:134-137``)
+  becomes "a job occupies exactly one contiguous block" — which also
+  guarantees its collectives ride ICI.
+- Strategy and placement merge into one joint binary ``x[t][(size, block)]``
+  per task: exactly-one per task covers both ``bss`` and ``bna``.
+- Big-M is the total runtime bound, not 1e10 (``milp.py:163`` used 1e10 and
+  leaned on Gurobi's IntFeasTol; HiGHS is happier with tight Ms).
+- Solved with HiGHS via ``saturn_tpu.solver.lp`` (no Gurobi/PuLP in-image).
+
+The introspection compare-and-swap (``milp.py:354-444``) lives in
+``resolve()``: re-solve each interval, adopt the new plan only if it beats the
+old one by more than interval+threshold, else slide the old plan down.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from saturn_tpu.core.mesh import Block, SliceTopology
+from saturn_tpu.solver.lp import Expr, Model
+
+log = logging.getLogger("saturn_tpu")
+
+
+@dataclass
+class Assignment:
+    """One task's slot in the plan."""
+
+    apportionment: int      # sub-mesh size (chips)
+    block: Block            # which aligned block of the ring
+    start: float            # start time, seconds from interval origin
+    runtime: float          # estimated remaining runtime under this strategy
+
+
+@dataclass
+class Plan:
+    """Decoded schedule (reference ``convert_into_comprehensible``,
+    ``milp.py:448-513``)."""
+
+    assignments: Dict[str, Assignment]          # task name -> slot
+    makespan: float
+    dependencies: Dict[str, List[str]] = field(default_factory=dict)
+
+    def compute_dependencies(self) -> None:
+        """Edges between tasks whose blocks overlap: later start depends on
+        earlier (reference builds deps from GPU-overlap ∩ boa,
+        ``milp.py:489-511``)."""
+        deps: Dict[str, List[str]] = {name: [] for name in self.assignments}
+        items = list(self.assignments.items())
+        for i, (n1, a1) in enumerate(items):
+            for n2, a2 in items[i + 1 :]:
+                if a1.block.overlaps(a2.block):
+                    if a1.start <= a2.start:
+                        deps[n2].append(n1)
+                    else:
+                        deps[n1].append(n2)
+        self.dependencies = deps
+
+
+def solve(
+    task_list: List,
+    topology: SliceTopology,
+    time_limit: Optional[float] = None,
+    ordering_slack: float = 1.0,
+) -> Plan:
+    """Build and solve the joint strategy/placement/schedule MILP.
+
+    Each task contributes its *feasible* strategies (``params is not None`` —
+    the reference's dummy-strategy exclusion, ``PerformanceEvaluator.py:96-110``).
+    Tasks with no feasible strategy raise — better than silently dropping.
+    """
+    for t in task_list:
+        if not t.feasible_strategies():
+            raise ValueError(f"task {t.name} has no feasible strategy; run search first")
+
+    m = Model("spase")
+    # Joint (strategy,block) choice per task.
+    choices: Dict[str, List[Tuple[int, Block, float]]] = {}
+    x: Dict[str, List] = {}
+    for t in task_list:
+        opts = []
+        for size, strat in sorted(t.feasible_strategies().items()):
+            if size > topology.capacity:
+                continue
+            for blk in topology.blocks(size):
+                opts.append((size, blk, strat.runtime))
+        if not opts:
+            raise ValueError(
+                f"task {t.name}: no strategy fits topology capacity {topology.capacity}"
+            )
+        choices[t.name] = opts
+        x[t.name] = [m.binary(f"x_{t.name}_{s}_{b.offset}") for s, b, _ in opts]
+        m.add(sum(x[t.name][1:], Expr.of(x[t.name][0])) == 1)
+
+    # Horizon T: serial-sum of worst-case runtimes plus per-pair ordering
+    # slack — no valid schedule needs starts beyond it. The big-M must relax
+    # ``sta_i >= sta_j + rt_j + slack - M`` even at sta_j = T, so M ≈ 2T
+    # (the reference sidestepped this with M=1e10 and solver IntFeasTol,
+    # ``milp.py:163``; HiGHS prefers tight-but-sufficient).
+    T = sum(max(s.runtime for s in t.feasible_strategies().values()) for t in task_list)
+    T += max(0, len(task_list) - 1) * ordering_slack
+    T = max(T, 1.0) * 1.05
+    M = 2.0 * T + 1.0
+
+    sta = {t.name: m.continuous(f"sta_{t.name}", lb=0.0, ub=T) for t in task_list}
+    makespan = m.continuous("makespan", lb=0.0, ub=T)
+
+    def runtime_expr(name: str) -> Expr:
+        e = Expr()
+        for xi, (_, _, rt) in zip(x[name], choices[name]):
+            e = e + xi * rt
+        return e
+
+    def occ_expr(name: str, dev: int) -> Expr:
+        """Linear expression: does task occupy device ``dev``? (analog of the
+        reference's tga occupancy vars, ``milp.py:184-195`` — here derived,
+        not free variables)."""
+        e = Expr()
+        for xi, (_, blk, _) in zip(x[name], choices[name]):
+            if blk.offset <= dev < blk.end:
+                e = e + xi
+        return e
+
+    # makespan >= start + runtime of the selected option (``milp.py:170-177``)
+    for t in task_list:
+        m.add(makespan >= sta[t.name] + runtime_expr(t.name))
+
+    # Worker exclusion: tasks sharing any device must be fully ordered with no
+    # overlap in time (``milp.py:277-319``).
+    names = [t.name for t in task_list]
+    for i, n1 in enumerate(names):
+        for n2 in names[i + 1 :]:
+            # skip pairs that can never overlap (disjoint choice sets)
+            may_overlap = any(
+                b1.overlaps(b2)
+                for _, b1, _ in choices[n1]
+                for _, b2, _ in choices[n2]
+            )
+            if not may_overlap:
+                continue
+            boa = m.binary(f"boa_{n1}_{n2}")  # 1 => n1 before n2
+            for dev in range(topology.capacity):
+                o1, o2 = occ_expr(n1, dev), occ_expr(n2, dev)
+                # if both occupy dev and boa=1: sta2 >= sta1 + rt1
+                m.add(
+                    sta[n2]
+                    >= sta[n1]
+                    + runtime_expr(n1)
+                    + ordering_slack
+                    - M * (1 - Expr.of(boa))
+                    - M * (2 - o1 - o2)
+                )
+                m.add(
+                    sta[n1]
+                    >= sta[n2]
+                    + runtime_expr(n2)
+                    + ordering_slack
+                    - M * Expr.of(boa)
+                    - M * (2 - o1 - o2)
+                )
+
+    # Tiny pressure toward early starts (keeps solutions canonical).
+    m.minimize(makespan + sum((sta[n] for n in names), Expr()) * (1e-6 / max(len(names), 1)))
+
+    res = m.solve(time_limit=time_limit)
+    if not res.ok:
+        log.warning("MILP infeasible/error — falling back to greedy schedule")
+        return greedy_plan(task_list, topology)
+
+    assignments: Dict[str, Assignment] = {}
+    for t in task_list:
+        vals = [res.value(xi) for xi in x[t.name]]
+        k = max(range(len(vals)), key=lambda i: vals[i])  # argmax like ``milp.py:471-486``
+        size, blk, rt = choices[t.name][k]
+        assignments[t.name] = Assignment(
+            apportionment=size,
+            block=blk,
+            start=max(0.0, res.value(sta[t.name])),
+            runtime=rt,
+        )
+    plan = Plan(assignments=assignments, makespan=res.value(makespan))
+    plan.compute_dependencies()
+    return plan
+
+
+def greedy_plan(task_list: List, topology: SliceTopology) -> Plan:
+    """List-scheduling fallback: longest task first, earliest feasible
+    (block, time) slot, choosing the strategy that minimizes finish time.
+    Used when the MILP times out dry — the reference had no fallback and
+    would just fail."""
+    events: Dict[int, List[Tuple[float, float]]] = {
+        d: [] for d in range(topology.capacity)
+    }  # per device: list of (start, end)
+
+    def earliest_free(blk: Block, duration: float) -> float:
+        """Earliest t such that [t, t+duration) is free on all devices of blk."""
+        busy = sorted(
+            iv for d in range(blk.offset, blk.end) for iv in events[d]
+        )
+        t0 = 0.0
+        for s, e in busy:
+            if t0 + duration <= s:
+                break
+            t0 = max(t0, e)
+        return t0
+
+    order = sorted(
+        task_list,
+        key=lambda t: -min(s.runtime for s in t.feasible_strategies().values()),
+    )
+    assignments: Dict[str, Assignment] = {}
+    for t in order:
+        best = None  # (finish, start, size, blk, rt)
+        for size, strat in sorted(t.feasible_strategies().items()):
+            if size > topology.capacity:
+                continue
+            for blk in topology.blocks(size):
+                st = earliest_free(blk, strat.runtime)
+                fin = st + strat.runtime
+                if best is None or fin < best[0]:
+                    best = (fin, st, size, blk, strat.runtime)
+        assert best is not None
+        fin, st, size, blk, rt = best
+        for d in range(blk.offset, blk.end):
+            events[d].append((st, fin))
+        assignments[t.name] = Assignment(size, blk, st, rt)
+
+    makespan = max((a.start + a.runtime for a in assignments.values()), default=0.0)
+    plan = Plan(assignments=assignments, makespan=makespan)
+    plan.compute_dependencies()
+    return plan
+
+
+def resolve(
+    task_list: List,
+    topology: SliceTopology,
+    previous: Optional[Plan],
+    interval: float,
+    threshold: float = 0.0,
+    time_limit: Optional[float] = None,
+) -> Plan:
+    """Introspective re-solve with compare-and-swap (``milp.py:354-444``).
+
+    Adopt the fresh plan iff (a) there was no previous plan, (b) the task set
+    shrank (``milp.py:376-379``), or (c) the fresh makespan beats the slid-down
+    old plan by more than ``threshold`` (``milp.py:394-427``). Otherwise keep
+    the old plan with all start times slid down by ``interval``
+    (``milp.py:429-442``).
+    """
+    fresh = solve(task_list, topology, time_limit=time_limit)
+    if previous is None:
+        return fresh
+
+    prev_names = set(previous.assignments)
+    cur_names = {t.name for t in task_list}
+    if cur_names - prev_names:
+        return fresh  # new tasks appeared: old plan can't cover them
+    if len(cur_names) < len(prev_names):
+        return fresh  # reference adopts on shrink (``milp.py:376-379``)
+
+    slid = Plan(
+        assignments={
+            n: Assignment(
+                a.apportionment,
+                a.block,
+                max(0.0, a.start - interval),
+                a.runtime,
+            )
+            for n, a in previous.assignments.items()
+            if n in cur_names
+        },
+        makespan=max(0.0, previous.makespan - interval),
+    )
+    slid.compute_dependencies()
+    if fresh.makespan < slid.makespan - threshold:
+        return fresh
+    return slid

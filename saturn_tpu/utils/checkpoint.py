@@ -1,0 +1,91 @@
+"""Path-keyed pytree checkpointing with cross-technique resharding.
+
+The reference checkpoints model state only, via ``torch.save`` of a state dict
+(``Task.py:150-169``), and silently drops optimizer state between intervals
+(``FSDP.py:220``, ``DDP.py:163``) — a wart SURVEY.md §5 flags to fix. Here we
+save the **full train state** (params + optimizer state + step) as host numpy
+arrays keyed by their tree path; the data cursor is derived from ``step`` on
+restore, making resume restart-safe.
+
+Saving by *path* rather than pickling tree structure is what makes
+interval-boundary **technique switching** work (the reference's central trick,
+``executor.py:65`` kill-and-respawn + state-dict reload): any technique can
+restore the same arrays under a *different* mesh/sharding, because restore maps
+host arrays onto a freshly-initialized template state and the caller then
+``device_put``s them with its own sharding.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from saturn_tpu.utils.treepath import path_str as _path_str
+
+
+def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
+    """Flatten a (possibly sharded, device-resident) pytree to host numpy."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out: Dict[str, np.ndarray] = {}
+    for path, leaf in flat:
+        key = _path_str(path)
+        if key in out:
+            raise ValueError(f"duplicate tree path key: {key!r}")
+        arr = np.asarray(jax.device_get(leaf))
+        # npz can't round-trip ml_dtypes (bfloat16/fp8); widen to float32 —
+        # restore() narrows back to the template's dtype.
+        if arr.dtype.kind == "V" or "bfloat16" in str(arr.dtype) or "float8" in str(arr.dtype):
+            arr = arr.astype(np.float32)
+        out[key] = arr
+    return out
+
+
+def save(path: str, tree: Any) -> None:
+    """Atomically write a pytree checkpoint to ``path`` (an ``.npz`` file)."""
+    arrays = flatten_to_host(tree)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def restore(path: str, template: Any) -> Any:
+    """Map saved arrays onto ``template``'s structure (host numpy leaves).
+
+    ``template`` is a freshly-initialized train state (any technique's); leaves
+    are replaced by the saved arrays with dtype preserved from the template so
+    a bf16 param set restores as bf16 even though numpy stored it widened.
+    """
+    with np.load(path) as data:
+        saved = {k: data[k] for k in data.files}
+
+    def replace(tree_path, leaf):
+        key = _path_str(tree_path)
+        if key not in saved:
+            raise KeyError(
+                f"checkpoint at {path!r} missing array for tree path {key!r}"
+            )
+        arr = saved[key]
+        want_dtype = getattr(leaf, "dtype", arr.dtype)
+        want_shape = getattr(leaf, "shape", arr.shape)
+        if tuple(arr.shape) != tuple(want_shape):
+            raise ValueError(
+                f"shape mismatch at {key!r}: saved {arr.shape} vs template {want_shape}"
+            )
+        return arr.astype(want_dtype)
+
+    return jax.tree_util.tree_map_with_path(replace, template)
+
+
+def exists(path: str) -> bool:
+    return os.path.exists(path)

@@ -1,0 +1,24 @@
+"""Single canonical tree-path stringifier.
+
+Used both for checkpoint array keys (``utils/checkpoint.py``) and sharding
+rule paths (``parallel/sharding.py``) — one implementation so saved keys and
+rule patterns can never silently disagree.
+"""
+
+from __future__ import annotations
+
+
+def path_str(path) -> str:
+    """Stable '/'-joined key for a jax tree path (DictKey / SequenceKey /
+    GetAttrKey / FlattenedIndexKey)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)

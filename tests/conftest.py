@@ -1,0 +1,64 @@
+"""Test harness: 8 virtual CPU devices (SURVEY.md §4's test-pyramid plan).
+
+Multi-device behavior is tested without TPU hardware via XLA's host-platform
+device emulation — the TPU-native analog of the reference's fake-8-GPUs solver
+stub (``milp.py:57-62``), but as a proper fixture instead of a hardcoded flag.
+Must run before jax initializes its backends, hence top of conftest.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+# The image's sitecustomize force-registers the axon TPU plugin and pins
+# JAX_PLATFORMS=axon; the config update wins over the env var.
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the test models are identical across runs, so
+# re-runs skip XLA compilation (big win on the single-core CI host).
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def tiny_task(tmp_path):
+    """A GPT-2 test-tiny task over a synthetic corpus — fast on CPU."""
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    def get_model(**kw):
+        return build_gpt2("test-tiny", **kw)
+
+    def get_loader():
+        return make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256, n_tokens=64 * 8 * 8
+        )
+
+    return Task(
+        get_model=get_model,
+        get_dataloader=get_loader,
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=16),
+        save_dir=str(tmp_path / "ckpts"),
+    )

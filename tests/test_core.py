@@ -1,0 +1,194 @@
+"""Unit tests for core representations, mesh topology, library, checkpointing."""
+
+import numpy as np
+import pytest
+
+from saturn_tpu import HParams, Strategy, Task, library
+from saturn_tpu.core.mesh import Block, SliceTopology, make_submesh
+from saturn_tpu.core.technique import BaseTechnique
+
+
+class TestHParams:
+    def test_epochs_xor_batch_count(self):
+        HParams(epochs=1)
+        HParams(batch_count=5)
+        with pytest.raises(ValueError):
+            HParams()  # neither
+        with pytest.raises(ValueError):
+            HParams(epochs=1, batch_count=5)  # both
+
+    def test_unknown_optimizer(self):
+        with pytest.raises(ValueError):
+            HParams(batch_count=1, optimizer="nope")
+
+    def test_optimizer_factory(self):
+        import optax
+
+        tx = HParams(batch_count=1, optimizer="adamw").make_optimizer()
+        assert isinstance(tx, optax.GradientTransformation)
+        tx2 = HParams(batch_count=1, optimizer=lambda lr: optax.sgd(lr)).make_optimizer()
+        assert isinstance(tx2, optax.GradientTransformation)
+
+
+class TestStrategy:
+    def test_feasible(self):
+        assert not Strategy(None, 4, None, 1e6).feasible
+        assert Strategy(object(), 4, {}, 10.0).feasible
+
+    def test_bad_apportionment(self):
+        with pytest.raises(ValueError):
+            Strategy(None, 0, None, 1.0)
+
+
+class TestBlocks:
+    def test_alignment(self):
+        Block(0, 4)
+        Block(4, 4)
+        with pytest.raises(ValueError):
+            Block(2, 4)  # misaligned
+        with pytest.raises(ValueError):
+            Block(0, 3)  # not pow2
+
+    def test_overlap_nesting(self):
+        # buddy property: blocks either nest or are disjoint
+        assert Block(0, 4).overlaps(Block(0, 2))
+        assert Block(0, 4).overlaps(Block(2, 2))
+        assert not Block(0, 4).overlaps(Block(4, 4))
+
+
+class TestTopology:
+    def test_sizes_and_blocks(self, devices8):
+        topo = SliceTopology(devices8)
+        assert topo.capacity == 8
+        assert topo.valid_sizes() == [1, 2, 4, 8]
+        assert len(topo.blocks(2)) == 4
+        assert [b.offset for b in topo.blocks(4)] == [0, 4]
+
+    def test_non_pow2_devices(self, devices8):
+        topo = SliceTopology(devices8[:6])
+        assert topo.capacity == 4
+
+    def test_make_submesh(self, devices8):
+        mesh = make_submesh(devices8[:4], ("data",))
+        assert mesh.devices.shape == (4,)
+        mesh2 = make_submesh(devices8, ("data", "model"), (4, 2))
+        assert mesh2.devices.shape == (4, 2)
+        mesh3 = make_submesh(devices8, ("data", "model"), (-1, 2))
+        assert mesh3.devices.shape == (4, 2)
+        with pytest.raises(ValueError):
+            make_submesh(devices8, ("data", "model"), (3, 2))
+
+
+class TestLibrary:
+    def test_register_type_check(self):
+        with pytest.raises(TypeError):
+            library.register("bad", object)
+
+    def test_register_retrieve_deregister(self):
+        class Dummy(BaseTechnique):
+            name = "dummy"
+
+            def execute(self, task, devices, tid, override_batch_count=None):
+                pass
+
+            def search(self, task, devices, tid):
+                return {}, 1.0
+
+        library.register("dummy", Dummy)
+        assert library.retrieve("dummy") is Dummy
+        assert Dummy in library.retrieve(["dummy"])
+        library.deregister("dummy")
+        with pytest.raises(KeyError):
+            library.retrieve("dummy")
+
+    def test_default_library(self):
+        names = library.register_default_library()
+        assert "dp" in names and "fsdp" in names and "tp" in names
+        for n in names:
+            assert issubclass(library.retrieve(n), BaseTechnique)
+
+    def test_dill_persistence(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SATURN_TPU_LIBRARY_PATH", str(tmp_path))
+
+        class Dummy2(BaseTechnique):
+            def execute(self, task, devices, tid, override_batch_count=None):
+                pass
+
+            def search(self, task, devices, tid):
+                return {}, 1.0
+
+        library.register("dummy2", Dummy2)
+        assert (tmp_path / "dummy2.udp").exists()
+        # wipe in-process registry entry; retrieve must reload from disk
+        library._REGISTRY.pop("dummy2")
+        cls = library.retrieve("dummy2")
+        assert cls.__name__ == "Dummy2"
+        library.deregister("dummy2")
+        assert not (tmp_path / "dummy2.udp").exists()
+
+
+class TestTask:
+    def test_task_basics(self, tiny_task):
+        t = tiny_task
+        assert t.epoch_length == 8
+        assert t.total_batches == 16
+        assert len(t.name) == 16  # random hex name, reference Task.py:107-109
+        b = t.batch_at(0)
+        assert b.shape == (8, 64)
+        # O(1) wraparound access
+        assert np.array_equal(t.batch_at(t.epoch_length), t.batch_at(0))
+
+    def test_reconfigure_wraps(self, tiny_task):
+        tiny_task.reconfigure(5)
+        assert tiny_task.current_batch == 5
+        tiny_task.reconfigure(6)
+        assert tiny_task.current_batch == 3  # (5+6) % 8
+
+    def test_select_strategy(self, tiny_task):
+        s = Strategy(object(), 2, {}, 5.0)
+        tiny_task.strategies[2] = s
+        tiny_task.select_strategy(2)
+        assert tiny_task.selected_strategy is s
+        assert tiny_task.feasible_strategies() == {2: s}
+
+
+class TestCheckpoint:
+    def test_roundtrip_and_template_restore(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        tree = {
+            "params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+            "step": jnp.asarray(7, dtype=jnp.int32),
+        }
+        p = str(tmp_path / "c.npz")
+        ckpt.save(p, tree)
+        template = jax.eval_shape(lambda: tree)
+        out = ckpt.restore(p, template)
+        assert np.array_equal(out["params"]["w"], np.arange(6).reshape(2, 3))
+        assert out["step"] == 7
+
+    def test_dtype_follows_template(self, tmp_path):
+        import jax.numpy as jnp
+        import jax
+
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        tree = {"w": jnp.ones((4,), dtype=jnp.bfloat16)}
+        p = str(tmp_path / "c.npz")
+        ckpt.save(p, tree)
+        out = ckpt.restore(p, jax.eval_shape(lambda: tree))
+        assert out["w"].dtype == jnp.bfloat16
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        p = str(tmp_path / "c.npz")
+        ckpt.save(p, {"w": jnp.ones((4,))})
+        with pytest.raises(ValueError):
+            ckpt.restore(p, jax.eval_shape(lambda: {"w": jnp.ones((5,))}))

@@ -1,0 +1,166 @@
+"""Engine + orchestrator control-plane tests with fake (hardware-free)
+techniques: forecast arithmetic, dependency gating, interval looping."""
+
+import threading
+import time
+
+import pytest
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.core.technique import BaseTechnique
+from saturn_tpu.executor import engine
+from saturn_tpu.executor.orchestrator import orchestrate
+from saturn_tpu.solver.milp import solve
+
+
+class FakeDev:
+    pass
+
+
+def topo(n=8):
+    return SliceTopology([FakeDev() for _ in range(n)])
+
+
+class RecordingTech(BaseTechnique):
+    """Sleeps per batch; records (task, block-size, batches, thread) calls."""
+
+    name = "fake"
+
+    def __init__(self, per_batch=0.001):
+        self.per_batch = per_batch
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        time.sleep(self.per_batch * (override_batch_count or 1))
+        with self.lock:
+            self.calls.append(
+                (task.name, len(devices), override_batch_count, time.monotonic())
+            )
+
+    def search(self, task, devices, tid):
+        return {}, self.per_batch
+
+
+class FakeTask:
+    def __init__(self, name, total_batches, sizes, tech, pbt=0.001):
+        self.name = name
+        self.total_batches = total_batches
+        self.current_batch = 0
+        self.epoch_length = 1000
+        self.strategies = {
+            g: Strategy(tech, g, {}, pbt * total_batches, pbt) for g in sizes
+        }
+        self.selected_strategy = None
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+    def select_strategy(self, g):
+        self.selected_strategy = self.strategies[g]
+
+    def reconfigure(self, n):
+        self.current_batch = (self.current_batch + n) % self.epoch_length
+
+
+class TestForecast:
+    def test_budget_and_completion(self):
+        tech = RecordingTech()
+        t1 = FakeTask("a", total_batches=10, sizes=[4], tech=tech, pbt=1.0)
+        t2 = FakeTask("b", total_batches=100, sizes=[4], tech=tech, pbt=1.0)
+        plan = solve([t1, t2], topo(8), ordering_slack=0.0)
+        run, batches, completed = engine.forecast([t1, t2], interval=50.0, plan=plan)
+        assert t1 in run and batches["a"] == 10  # capped at remaining
+        assert t1 in completed
+        assert t2 in run and batches["b"] <= 50
+        assert t2 not in completed
+        # online re-estimation decremented remaining work
+        assert t1.total_batches == 0
+        assert t2.total_batches == 100 - batches["b"]
+        assert t2.strategies[4].runtime == pytest.approx(t2.total_batches * 1.0)
+
+    def test_slow_task_still_progresses(self):
+        """A task whose per-batch time exceeds the interval must get >= 1
+        batch — otherwise orchestrate() livelocks re-solving forever."""
+        tech = RecordingTech()
+        t = FakeTask("slow", total_batches=3, sizes=[8], tech=tech, pbt=2000.0)
+        plan = solve([t], topo(8))
+        run, batches, _ = engine.forecast([t], interval=1000.0, plan=plan)
+        assert t in run and batches["slow"] == 1
+
+    def test_task_beyond_interval_skipped(self):
+        tech = RecordingTech()
+        t1 = FakeTask("a", 10, [8], tech, pbt=10.0)  # 100s job
+        t2 = FakeTask("b", 10, [8], tech, pbt=10.0)
+        plan = solve([t1, t2], topo(8), ordering_slack=0.0)
+        run, batches, _ = engine.forecast([t1, t2], interval=50.0, plan=plan)
+        # only the first-scheduled task fits in the 50s interval
+        assert len(run) == 1
+
+
+class TestExecute:
+    def test_dependency_ordering(self):
+        """Tasks sharing a block must run in plan order, not concurrently."""
+        tech = RecordingTech(per_batch=0.005)
+        t1 = FakeTask("a", 5, [8], tech, pbt=1.0)
+        t2 = FakeTask("b", 5, [8], tech, pbt=1.0)
+        plan = solve([t1, t2], topo(8), ordering_slack=0.0)
+        run, batches, _ = engine.forecast([t1, t2], interval=100.0, plan=plan)
+        assert len(run) == 2
+        engine.execute(run, batches, 100.0, plan, topo(8))
+        order = {name: ts for name, _, _, ts in tech.calls}
+        dep = plan.dependencies
+        later = "a" if dep["a"] else "b"
+        earlier = "b" if later == "a" else "a"
+        assert order[earlier] < order[later]
+
+    def test_parallel_disjoint_blocks(self):
+        tech = RecordingTech(per_batch=0.01)
+        t1 = FakeTask("a", 5, [4], tech, pbt=1.0)
+        t2 = FakeTask("b", 5, [4], tech, pbt=1.0)
+        plan = solve([t1, t2], topo(8), ordering_slack=0.0)
+        run, batches, _ = engine.forecast([t1, t2], interval=100.0, plan=plan)
+        engine.execute(run, batches, 100.0, plan, topo(8))
+        assert len(tech.calls) == 2
+        assert {c[1] for c in tech.calls} == {4}
+
+    def test_error_propagates(self):
+        class Exploding(RecordingTech):
+            def execute(self, *a, **k):
+                raise RuntimeError("boom")
+
+        tech = Exploding()
+        t1 = FakeTask("a", 5, [4], tech, pbt=1.0)
+        plan = solve([t1], topo(8))
+        run, batches, _ = engine.forecast([t1], 100.0, plan)
+        with pytest.raises(RuntimeError, match="interval execution failed"):
+            engine.execute(run, batches, 100.0, plan, topo(8))
+
+
+class TestOrchestrate:
+    def test_runs_all_to_completion(self):
+        tech = RecordingTech(per_batch=0.0005)
+        tasks = [
+            FakeTask(f"t{i}", total_batches=20, sizes=[2, 4], tech=tech, pbt=0.5)
+            for i in range(4)
+        ]
+        orchestrate(tasks, interval=6.0, topology=topo(8), solver_time_limit=5.0)
+        done = {}
+        for name, _, n, _ in tech.calls:
+            done[name] = done.get(name, 0) + n
+        assert done == {f"t{i}": 20 for i in range(4)}
+
+    def test_multi_interval_progress(self):
+        """Work larger than one interval completes over several rounds."""
+        tech = RecordingTech(per_batch=0.0005)
+        tasks = [FakeTask("big", total_batches=30, sizes=[8], tech=tech, pbt=1.0)]
+        orchestrate(tasks, interval=10.0, topology=topo(8), solver_time_limit=2.0)
+        total = sum(n for _, _, n, _ in tech.calls)
+        assert total == 30
+        assert len(tech.calls) >= 3  # 30 batches at 1s/batch vs 10s intervals
+
+    def test_unprofiled_task_raises(self):
+        t = FakeTask("a", 5, [], RecordingTech())
+        with pytest.raises(ValueError, match="no profiled strategies"):
+            orchestrate([t], topology=topo(8))
