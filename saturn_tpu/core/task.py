@@ -103,6 +103,14 @@ class Task:
         self.current_batch = 0  # data cursor, persists across intervals
         self.strategies: Dict[int, Strategy] = {}
         self.selected_strategy: Optional[Strategy] = None
+        # Device-resident train state from the most recent interval, keyed by
+        # (technique, config, block) — lets consecutive intervals under an
+        # unchanged assignment skip the checkpoint disk round-trip.
+        self._live_state: Optional[tuple] = None
+
+    def release_live_state(self) -> None:
+        """Drop the cached device state (frees HBM once the task finishes)."""
+        self._live_state = None
 
     # ------------------------------------------------------------------ model
     def get_model(self, **overrides):

@@ -43,6 +43,13 @@ def orchestrate(
     if log:
         logging.basicConfig(level=logging.INFO)
     topo = topology if topology is not None else SliceTopology()
+    names = [t.name for t in task_list]
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise ValueError(
+            f"duplicate task names {dupes}: every subsystem (plan, engine, "
+            "checkpoints) keys on task.name — give tasks unique names"
+        )
     for t in task_list:
         if not t.feasible_strategies():
             raise ValueError(
@@ -74,6 +81,10 @@ def orchestrate(
                 # it): the slide in resolve() brings work forward next round.
                 logger.info("idle interval: no task starts within %.1fs", interval)
 
+            for t in completed:
+                release = getattr(t, "release_live_state", None)
+                if release is not None:
+                    release()  # free HBM held by finished tasks
             task_list = remaining
             if future is not None:
                 plan = future.result()

@@ -1,0 +1,7 @@
+"""TPU-native ops: explicit-schedule collectives and Pallas kernels.
+
+The reference delegated all of this to external CUDA libraries (torchgpipe
+streams, fairscale offload, NCCL — SURVEY.md §2.2). Here the hot schedules are
+written against JAX primitives (``shard_map`` + ``ppermute`` + ``lax.scan``)
+and Pallas where a fused kernel beats XLA's default lowering.
+"""

@@ -1,0 +1,173 @@
+"""GPipe microbatch schedule over a ``stage`` mesh axis, as one jittable op.
+
+TPU-native replacement for torchgpipe (reference ``Pipeline.py:24-167``,
+SURVEY.md §2.2): where torchgpipe partitions an ``nn.Sequential`` across GPUs
+and streams microbatches over CUDA copies, here the scanned layer stack is
+*sharded* over a ``stage`` mesh axis and microbatch activations rotate between
+neighbor stages with ``lax.ppermute`` — point-to-point hops that ride ICI.
+
+The whole schedule lives inside ``shard_map`` and is differentiated with
+``jax.value_and_grad`` *inside* the mapped body: ``ppermute``'s transpose is
+the inverse permutation, so reverse-mode AD automatically yields the reverse
+pipeline schedule (activations flow last→first stage in the backward pass),
+with no hand-written backward.
+
+Schedule shape (classic GPipe, bubble fraction (S-1)/(M+S-1)):
+
+    t:      0    1    2    ...                    M+S-2
+    stage0  mb0  mb1  mb2  ...  mbM-1  -    -
+    stage1  -    mb0  mb1  ...         mbM-1 -
+    stage2  -    -    mb0  ...               mbM-1
+
+The language-model head is *not* computed inside the schedule loop (which
+would redo it on every stage every tick): last-stage outputs are collected,
+psum-broadcast, and each stage computes the head + loss for an M/S chunk of
+microbatches — balancing the vocab-sized matmul across the gang.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_loss_and_grads(
+    params: Any,
+    tokens: jax.Array,
+    *,
+    mesh: Any,
+    block_key: str,
+    embed_fn: Callable[[Any, jax.Array], jax.Array],
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    head_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    n_microbatches: int,
+    remat: bool = False,
+    data_axis: str = "data",
+    stage_axis: str = "stage",
+):
+    """(loss, grads) for one pipelined step over a ('data','stage') mesh.
+
+    ``params`` is the full param tree; ``params[block_key]`` must carry a
+    leading layer axis divisible by the stage count (the model-structure
+    contract the reference imposed via ``nn.Sequential`` flattening,
+    ``GPTJ.py:502-526``). ``tokens`` is the global (B, T) batch; each data
+    shard is split into ``n_microbatches`` microbatches.
+    """
+    S = mesh.shape[stage_axis]
+    M = n_microbatches
+    if M % S != 0:
+        raise ValueError(f"n_microbatches {M} must be a multiple of stages {S}")
+
+    one_block = jax.checkpoint(block_fn) if remat else block_fn
+
+    def run_stage(local_blocks, x):
+        def body(h, layer_params):
+            return one_block(layer_params, h), None
+
+        y, _ = lax.scan(body, x, local_blocks)
+        return y
+
+    block_specs = jax.tree.map(lambda _: P(stage_axis), params[block_key])
+    param_specs = {
+        k: (block_specs if k == block_key else jax.tree.map(lambda _: P(), v))
+        for k, v in params.items()
+    }
+
+    def local_fn(p, local_tokens):
+        """Runs on one (data shard, stage): local_tokens (Bd, T) int32."""
+        idx = lax.axis_index(stage_axis)
+        blocks = p[block_key]
+        other = {k: v for k, v in p.items() if k != block_key}
+
+        Bd, T = local_tokens.shape
+        if Bd % M != 0:
+            raise ValueError(f"per-shard batch {Bd} not divisible by M={M}")
+        mb = Bd // M
+        tokens_r = local_tokens.reshape(M, mb, T)
+
+        def loss_of(p_local):
+            blocks_, other_ = p_local
+            # Embeddings for every microbatch (only stage 0's are consumed;
+            # the gather is cheap next to the block stack).
+            emb = jax.vmap(lambda t: embed_fn(other_, t))(tokens_r)
+            act_shape = emb.shape[1:]
+            outs0 = jnp.zeros((M,) + act_shape, emb.dtype)
+
+            def tick(carry, t):
+                prev, outs = carry
+                inp0 = jnp.where(
+                    t < M,
+                    lax.dynamic_index_in_dim(
+                        emb, jnp.minimum(t, M - 1), keepdims=False
+                    ),
+                    jnp.zeros(act_shape, emb.dtype),
+                )
+                x_in = jnp.where(idx == 0, inp0, prev)
+                y = run_stage(blocks_, x_in)
+                # Record last-stage finished microbatch t-(S-1).
+                slot = jnp.clip(t - (S - 1), 0, M - 1)
+                cur = lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+                new = jnp.where(t >= S - 1, y, cur)
+                outs = lax.dynamic_update_index_in_dim(outs, new, slot, 0)
+                # Rotate activations one stage forward.
+                y_next = lax.ppermute(
+                    y, stage_axis, [(i, (i + 1) % S) for i in range(S)]
+                )
+                return (y_next, outs), None
+
+            zero = jnp.zeros(act_shape, emb.dtype)
+            (_, outs), _ = lax.scan(
+                tick, (zero, outs0), jnp.arange(M + S - 1)
+            )
+
+            # Broadcast last-stage outputs, head + loss on an M/S chunk each.
+            outs = lax.psum(
+                jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)), stage_axis
+            )
+            chunk = M // S
+            my_outs = lax.dynamic_slice_in_dim(outs, idx * chunk, chunk, 0)
+            my_tokens = lax.dynamic_slice_in_dim(tokens_r, idx * chunk, chunk, 0)
+
+            def one_loss(h, t):
+                return loss_fn(head_fn(other_, h), t)
+
+            loss_chunk = jnp.mean(jax.vmap(one_loss)(my_outs, my_tokens))
+            return lax.psum(loss_chunk, stage_axis) / S
+
+        loss, (g_blocks, g_other) = jax.value_and_grad(loss_of)((blocks, other))
+        # Cotangent bookkeeping shard_map leaves to us: replicated params get
+        # per-device partial grads — sum over stages; everything averages
+        # over the data axis (the DP grad sync NCCL did for the reference).
+        g_other = jax.tree.map(lambda g: lax.psum(g, stage_axis), g_other)
+        grads = dict(g_other)
+        grads[block_key] = g_blocks
+        grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+        loss = lax.pmean(loss, data_axis)
+        return loss, grads
+
+    grad_specs = dict(param_specs)
+    mapped = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(param_specs, P(data_axis)),
+        out_specs=(P(), grad_specs),
+        check_vma=False,
+    )
+    return mapped(params, tokens)
+
+
+def pipeline_hints(spec: Any) -> Dict[str, Any]:
+    """Extract and validate the model's pipeline decomposition hints."""
+    h = spec.hints.get("pipeline")
+    if h is None:
+        raise ValueError(
+            "model does not expose pipeline hints "
+            "(hints['pipeline'] with embed/block/head fns)"
+        )
+    return h

@@ -1,0 +1,110 @@
+"""Pipeline-parallel executor: GPipe over a ``stage`` mesh axis.
+
+Replaces the reference's torchgpipe UDP (``examples/wikitext103/executors/
+Pipeline.py:24-167``). Reference behavior preserved: partition the layer
+stack across workers (``balance_by_time`` → here the scanned layer axis is
+sharded evenly over stages, which is exact for a homogeneous stack), and
+autotune the microbatch count (``Pipeline.py:139-159`` halving sweep → grid
+over {M} multiples of the stage count). The schedule itself lives in
+``saturn_tpu.ops.pipeline`` (shard_map + ppermute).
+
+A ``data`` axis composes data parallelism with the pipeline: a mesh of
+``n`` devices runs ``n/S`` pipeline replicas of ``S`` stages each.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import optax
+from jax.sharding import PartitionSpec as P
+
+from saturn_tpu.ops.pipeline import pipeline_hints, pipeline_loss_and_grads
+from saturn_tpu.parallel.spmd_base import SPMDTechnique
+
+
+class Pipeline(SPMDTechnique):
+    name = "pp"
+
+    def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+        s = config.get("stages", 2)
+        if n_devices % s != 0:
+            raise ValueError(f"{n_devices} devices not divisible by {s} stages")
+        return ("data", "stage"), (n_devices // s, s)
+
+    def batch_spec(self, config) -> P:
+        return P("data")
+
+    def param_rules(self, task, config):
+        spec = task.get_model()
+        bkey = spec.hints.get("block_param_key", "blocks")
+        s = config.get("stages", 2)
+
+        def rules(path: str, shape: Tuple[int, ...], mesh_axes) -> P:
+            if bkey in path and shape and shape[0] % s == 0:
+                return P("stage")
+            return P()
+
+        return rules
+
+    def candidate_configs(self, task, n_devices) -> List[Dict[str, Any]]:
+        spec = task.get_model()
+        n_layers = getattr(spec.config, "n_layers", 1)
+        if "pipeline" not in spec.hints:
+            return []
+        batch = task.get_dataset().batch_size
+        grid: List[Dict[str, Any]] = []
+        s = 2
+        while s <= n_devices and n_layers % s == 0 and s <= n_layers:
+            d = n_devices // s
+            # Microbatch sweep, most-microbatches (smallest bubble) first —
+            # the analog of the reference's halving search (Pipeline.py:139).
+            for m in (4 * s, 2 * s, s):
+                if batch % (d * m) == 0:
+                    grid.append({"stages": s, "microbatches": m, "remat": False})
+                    grid.append({"stages": s, "microbatches": m, "remat": True})
+            s <<= 1
+        return grid
+
+    def make_step_fns(self, spec, task, config, mesh, ds):
+        s = config.get("stages", 2)
+        m = config.get("microbatches", 2 * s)
+        n_layers = getattr(spec.config, "n_layers", 1)
+        if n_layers % s != 0:
+            raise ValueError(f"{n_layers} layers not divisible by {s} stages")
+        hints = pipeline_hints(spec)
+        bkey = spec.hints.get("block_param_key", "blocks")
+        tx = task.hparams.make_optimizer()
+        loss_fn = task.loss_fn
+
+        def init_state():
+            params = spec.init_fn(jax.random.PRNGKey(0))
+            return {
+                "params": params,
+                "opt_state": tx.init(params),
+                "step": jax.numpy.zeros((), dtype=jax.numpy.int32),
+            }
+
+        def train_step(state, batch):
+            loss, grads = pipeline_loss_and_grads(
+                state["params"],
+                batch,
+                mesh=mesh,
+                block_key=bkey,
+                embed_fn=hints["embed"],
+                block_fn=hints["block"],
+                head_fn=hints["head"],
+                loss_fn=loss_fn,
+                n_microbatches=m,
+                remat=bool(config.get("remat", False)),
+            )
+            updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
+            return {
+                "params": new_params,
+                "opt_state": new_opt,
+                "step": state["step"] + 1,
+            }, loss
+
+        return init_state, train_step

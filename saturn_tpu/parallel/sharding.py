@@ -66,7 +66,9 @@ def tensor_parallel_rules(axis: str = "model"):
     col = re.compile(r"(qkv|mlp_in)/kernel$")
     row = re.compile(r"(attn_out|mlp_out)/kernel$")
     colb = re.compile(r"(qkv|mlp_in)/bias$")
-    vocab = re.compile(r"^wte$")
+    # Paths are full state paths ('params/wte', 'opt_state/0/mu/wte', ...),
+    # so anchor on a path segment, not the whole string.
+    vocab = re.compile(r"(^|/)wte$")
 
     def rules(path: str, shape: Tuple[int, ...], mesh_axes) -> P:
         n_shard = mesh_axes[axis]

@@ -99,6 +99,43 @@ class SPMDTechnique(BaseTechnique):
         """Memory kind for persistent state ('pinned_host' = offload)."""
         return None
 
+    def make_step_fns(
+        self, spec: Any, task: Any, config: Dict[str, Any], mesh: Any, ds: Any
+    ) -> Tuple[Any, Any]:
+        """(init_state, train_step) for this technique.
+
+        The default is the standard data/tensor-sharded step: loss over the
+        full global batch, grads, optax update — GSPMD inserts all
+        collectives from the shardings alone. Techniques with an explicit
+        schedule (pipeline) override this to build a ``shard_map`` step.
+        """
+        tx = task.hparams.make_optimizer()
+        loss_fn = task.loss_fn
+        apply_fn = spec.apply_fn
+
+        def init_state():
+            params = spec.init_fn(jax.random.PRNGKey(0))
+            return {
+                "params": params,
+                "opt_state": tx.init(params),
+                "step": jax.numpy.zeros((), dtype=jax.numpy.int32),
+            }
+
+        def train_step(state, batch):
+            def loss_of(p):
+                return loss_fn(apply_fn(p, batch), batch)
+
+            loss, grads = jax.value_and_grad(loss_of)(state["params"])
+            updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
+            return {
+                "params": new_params,
+                "opt_state": new_opt,
+                "step": state["step"] + 1,
+            }, loss
+
+        return init_state, train_step
+
     # -------------------------------------------------------------- building
     def _model_overrides(self, config: Dict[str, Any]) -> Dict[str, Any]:
         out = {}
@@ -135,31 +172,7 @@ class SPMDTechnique(BaseTechnique):
                 f"{data_axis}={mesh_axes.get(data_axis)}"
             )
 
-        tx = task.hparams.make_optimizer()
-        loss_fn = task.loss_fn
-        apply_fn = spec.apply_fn
-
-        def init_state():
-            params = spec.init_fn(jax.random.PRNGKey(0))
-            return {
-                "params": params,
-                "opt_state": tx.init(params),
-                "step": jax.numpy.zeros((), dtype=jax.numpy.int32),
-            }
-
-        def train_step(state, batch):
-            def loss_of(p):
-                return loss_fn(apply_fn(p, batch), batch)
-
-            loss, grads = jax.value_and_grad(loss_of)(state["params"])
-            updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
-            new_params = optax.apply_updates(state["params"], updates)
-            return {
-                "params": new_params,
-                "opt_state": new_opt,
-                "step": state["step"] + 1,
-            }, loss
-
+        init_state, train_step = self.make_step_fns(spec, task, config, mesh, ds)
         state_shapes = jax.eval_shape(init_state)
         rules = self.param_rules(task, config)
         mem_kind = self.param_memory_kind(config)
@@ -250,8 +263,16 @@ class SPMDTechnique(BaseTechnique):
     ) -> None:
         config = dict(task.selected_strategy.params or {})
         bundle = self.build(task, devices, config)
+        key = self._bundle_key(task, devices, config)
 
-        if task.has_ckpt():
+        live = getattr(task, "_live_state", None)
+        if live is not None and live[0] == key:
+            # Same technique/config/block as the previous interval: the
+            # device-resident state is still authoritative — skip the
+            # disk round-trip (the ckpt is only needed when the solver
+            # *switches* technique or block between intervals).
+            state = live[1]
+        elif task.has_ckpt():
             # Resume — restore host arrays and place them under THIS
             # technique's shardings (cross-technique resharding; the
             # reference's kill-and-respawn reload, ``FSDP.py:189-191``).
@@ -263,6 +284,11 @@ class SPMDTechnique(BaseTechnique):
             task.current_batch = int(host_state["step"]) % max(task.epoch_length, 1)
         else:
             state = bundle.init()
+
+        # The cached buffers get donated into the first step below, so they
+        # must not be offered again if this interval crashes mid-run: drop
+        # the cache now and re-publish after the end-of-interval checkpoint.
+        task._live_state = None
 
         n = override_batch_count
         if n is None:
@@ -284,3 +310,4 @@ class SPMDTechnique(BaseTechnique):
         # Full train-state checkpoint (params + opt state + step): fixes the
         # reference's dropped-optimizer wart (``FSDP.py:220``).
         ckpt.save(task.ckpt_path, state)
+        task._live_state = (key, state)
