@@ -22,6 +22,12 @@ def host_offload_supported() -> bool:
 
     try:
         dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            # The CPU backend advertises pinned_host but its SPMD
+            # partitioner rejects device-placement annotations (RET_CHECK
+            # "Side-effect HLO must have sharding"); restrict real
+            # offloading to TPU, where XLA host offload is production-grade.
+            return False
         kinds = {m.kind for m in dev.addressable_memories()}
         return "pinned_host" in kinds
     except Exception:

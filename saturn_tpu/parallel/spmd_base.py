@@ -107,11 +107,18 @@ class SPMDTechnique(BaseTechnique):
         The default is the standard data/tensor-sharded step: loss over the
         full global batch, grads, optax update — GSPMD inserts all
         collectives from the shardings alone. Techniques with an explicit
-        schedule (pipeline) override this to build a ``shard_map`` step.
+        schedule (pipeline) override this to build a ``shard_map`` step;
+        techniques that only change the forward pass (offload streaming)
+        override via ``step_fns_from_forward``.
         """
+        return self.step_fns_from_forward(spec, task, spec.apply_fn)
+
+    def step_fns_from_forward(
+        self, spec: Any, task: Any, forward: Any
+    ) -> Tuple[Any, Any]:
+        """Standard loss/grad/optax scaffold around ``forward(params, batch)``."""
         tx = task.hparams.make_optimizer()
         loss_fn = task.loss_fn
-        apply_fn = spec.apply_fn
 
         def init_state():
             params = spec.init_fn(jax.random.PRNGKey(0))
@@ -123,7 +130,7 @@ class SPMDTechnique(BaseTechnique):
 
         def train_step(state, batch):
             def loss_of(p):
-                return loss_fn(apply_fn(p, batch), batch)
+                return loss_fn(forward(p, batch), batch)
 
             loss, grads = jax.value_and_grad(loss_of)(state["params"])
             updates, new_opt = tx.update(grads, state["opt_state"], state["params"])

@@ -102,3 +102,40 @@ class TestTensorParallel:
     def test_infeasible_on_one_device(self, tiny_task, devices8):
         params, t = TensorParallel().search(tiny_task, devices8[:1], tid=0)
         assert params is None  # tp needs >= 2 devices
+
+
+class TestHostOffload:
+    def test_search_execute_ckpt(self, tiny_task, devices8):
+        from saturn_tpu.parallel.offload import HostOffload
+
+        run_search_and_execute(HostOffload(), tiny_task, devices8[:2])
+
+    def test_stream_matches_bulk_loss(self, tiny_task, devices8):
+        """Streaming per-layer fetch must compute the same math as the bulk
+        dense step (same init/data)."""
+        import jax
+
+        from saturn_tpu.parallel.offload import HostOffload
+
+        tech = HostOffload()
+        b_s = tech.build(tiny_task, devices8[:2], {"stream": True, "remat": True})
+        b_b = tech.build(tiny_task, devices8[:2], {"stream": False, "remat": False})
+        s_s, s_b = b_s.init(), b_b.init()
+        batch = tiny_task.batch_at(0)
+        _, l_s = b_s.step(s_s, jax.device_put(batch, b_s.batch_sharding))
+        _, l_b = b_b.step(s_b, jax.device_put(batch, b_b.batch_sharding))
+        np.testing.assert_allclose(float(l_s), float(l_b), rtol=2e-2)
+
+    def test_cross_technique_switch_from_offload(self, tiny_task, devices8):
+        """Offload -> DP technique switch at an interval boundary (on the CPU
+        test mesh state is device-resident — real pinned_host placement is
+        TPU-only and covered by the TPU bench/verify drives)."""
+        from saturn_tpu.parallel.offload import HostOffload
+
+        off, dp = HostOffload(), DataParallel()
+        run_search_and_execute(off, tiny_task, devices8[:1], n_batches=2)
+        tiny_task.strategies[2] = Strategy(dp, 2, {"remat": False}, 50.0, 0.1)
+        tiny_task.select_strategy(2)
+        dp.execute(tiny_task, devices8[:2], tid=0, override_batch_count=2)
+        state = np.load(tiny_task.ckpt_path)
+        assert state["step"] == 4
