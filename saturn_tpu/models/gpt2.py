@@ -42,6 +42,12 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False  # rematerialize blocks (activation checkpointing)
+    # Sequence-parallel mode: name of the mesh axis the sequence is sharded
+    # over. When set, the model must run inside shard_map — attention becomes
+    # ring attention (ops/ring.py) and positions are offset by the shard
+    # index. None = dense single-program attention.
+    seq_axis: Optional[str] = None
+    seq_axis_size: int = 1
     name: str = "gpt2-small"
 
     @property
@@ -99,13 +105,20 @@ class Block(nn.Module):
             return t.reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        # fp32 softmax accumulation for stability; matmuls stay bf16-in.
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
-        scores = scores / math.sqrt(cfg.head_dim)
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-        scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        if cfg.seq_axis is not None:
+            from saturn_tpu.ops.ring import ring_attention
+
+            attn = ring_attention(
+                q, k, v, axis_name=cfg.seq_axis, axis_size=cfg.seq_axis_size
+            )
+        else:
+            # fp32 softmax accumulation for stability; matmuls stay bf16-in.
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+            scores = scores / math.sqrt(cfg.head_dim)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
         x = x + nn.Dense(D, dtype=dt, param_dtype=pdt, name="attn_out")(attn)
 
@@ -138,7 +151,14 @@ class GPT2(nn.Module):
             (cfg.seq_len, cfg.d_model),
             cfg.param_dtype,
         )
-        x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
+        if cfg.seq_axis is not None:
+            # Local chunk of a sequence-sharded batch: positions offset by
+            # the shard index (T here is the per-shard chunk length).
+            offset = jax.lax.axis_index(cfg.seq_axis) * T
+            pos = jax.lax.dynamic_slice_in_dim(wpe, offset, T, axis=0)
+        else:
+            pos = wpe[:T]
+        x = wte[tokens].astype(cfg.dtype) + pos.astype(cfg.dtype)
 
         block_cls = Block
         if cfg.remat:
@@ -201,6 +221,7 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         "block_param_key": "blocks",  # where the scanned layer stack lives
         "n_layers": cfg.n_layers,
         "embed_param_keys": ("wte", "wpe"),
+        "seq_parallel": True,  # factory accepts seq_axis/seq_axis_size
         "pipeline": {
             "embed": pipeline_embed,
             "block": pipeline_block,

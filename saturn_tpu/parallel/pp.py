@@ -16,8 +16,6 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Tuple
 
-import jax
-import optax
 from jax.sharding import PartitionSpec as P
 
 from saturn_tpu.ops.pipeline import pipeline_hints, pipeline_loss_and_grads
@@ -75,20 +73,11 @@ class Pipeline(SPMDTechnique):
             raise ValueError(f"{n_layers} layers not divisible by {s} stages")
         hints = pipeline_hints(spec)
         bkey = spec.hints.get("block_param_key", "blocks")
-        tx = task.hparams.make_optimizer()
         loss_fn = task.loss_fn
 
-        def init_state():
-            params = spec.init_fn(jax.random.PRNGKey(0))
-            return {
-                "params": params,
-                "opt_state": tx.init(params),
-                "step": jax.numpy.zeros((), dtype=jax.numpy.int32),
-            }
-
-        def train_step(state, batch):
-            loss, grads = pipeline_loss_and_grads(
-                state["params"],
+        def loss_and_grads(params, batch):
+            return pipeline_loss_and_grads(
+                params,
                 batch,
                 mesh=mesh,
                 block_key=bkey,
@@ -99,12 +88,5 @@ class Pipeline(SPMDTechnique):
                 n_microbatches=m,
                 remat=bool(config.get("remat", False)),
             )
-            updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
-            new_params = optax.apply_updates(state["params"], updates)
-            return {
-                "params": new_params,
-                "opt_state": new_opt,
-                "step": state["step"] + 1,
-            }, loss
 
-        return init_state, train_step
+        return self.step_fns_from_loss_and_grads(spec.init_fn, task, loss_and_grads)

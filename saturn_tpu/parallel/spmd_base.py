@@ -117,11 +117,30 @@ class SPMDTechnique(BaseTechnique):
         self, spec: Any, task: Any, forward: Any
     ) -> Tuple[Any, Any]:
         """Standard loss/grad/optax scaffold around ``forward(params, batch)``."""
-        tx = task.hparams.make_optimizer()
         loss_fn = task.loss_fn
 
+        def loss_and_grads(params, batch):
+            def loss_of(p):
+                return loss_fn(forward(p, batch), batch)
+
+            return jax.value_and_grad(loss_of)(params)
+
+        return self.step_fns_from_loss_and_grads(spec.init_fn, task, loss_and_grads)
+
+    def step_fns_from_loss_and_grads(
+        self, init_params: Any, task: Any, loss_and_grads: Any
+    ) -> Tuple[Any, Any]:
+        """(init_state, train_step) around ``loss_and_grads(params, batch)``.
+
+        The single definition of the train-state layout ({params, opt_state,
+        step}) and the optimizer-update tail — every technique (dense,
+        offload, pipeline, ring) routes through here so the state contract
+        cannot diverge between them.
+        """
+        tx = task.hparams.make_optimizer()
+
         def init_state():
-            params = spec.init_fn(jax.random.PRNGKey(0))
+            params = init_params(jax.random.PRNGKey(0))
             return {
                 "params": params,
                 "opt_state": tx.init(params),
@@ -129,10 +148,7 @@ class SPMDTechnique(BaseTechnique):
             }
 
         def train_step(state, batch):
-            def loss_of(p):
-                return loss_fn(forward(p, batch), batch)
-
-            loss, grads = jax.value_and_grad(loss_of)(state["params"])
+            loss, grads = loss_and_grads(state["params"], batch)
             updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
             new_params = optax.apply_updates(state["params"], updates)
             return {
