@@ -42,6 +42,13 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False  # rematerialize blocks (activation checkpointing)
+    # GPT-J structure (reference ``GPTJ.py:44-79`` rotary helpers,
+    # ``GPTJ.py:392-424`` block): rotary position embeddings on the first
+    # ``rotary_dim`` dims of q/k (no learned positions), and the attention +
+    # MLP branches applied in parallel off one LayerNorm.
+    rotary: bool = False
+    rotary_dim: Optional[int] = None  # default: full head_dim
+    parallel_residual: bool = False
     # Sequence-parallel mode: name of the mesh axis the sequence is sharded
     # over. When set, the model must run inside shard_map — attention becomes
     # ring attention (ops/ring.py) and positions are offset by the shard
@@ -71,9 +78,41 @@ PRESETS: Dict[str, Dict[str, Any]] = {
     "gpt2-medium": dict(d_model=1024, n_layers=24, n_heads=16),
     "gpt2-large": dict(d_model=1280, n_layers=36, n_heads=20),
     "gpt2-xl": dict(d_model=1600, n_layers=48, n_heads=25),
-    # GPT-J-6B-shaped dense model (rotary omitted; learned positions).
-    "gptj-6b": dict(d_model=4096, n_layers=28, n_heads=16, d_ff=16384),
+    # GPT-J-6B: rotary on the first 64 head dims + parallel attn/MLP residual
+    # (reference ``GPTJ.py:82-268,392-424``; config ``GPTJ.py:504-507``).
+    "gptj-6b": dict(
+        d_model=4096, n_layers=28, n_heads=16, d_ff=16384,
+        rotary=True, rotary_dim=64, parallel_residual=True,
+    ),
+    "gptj-test-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, vocab_size=256, seq_len=64,
+        rotary=True, rotary_dim=8, parallel_residual=True,
+    ),
 }
+
+
+def rotary_sin_cos(positions: jax.Array, rotary_dim: int):
+    """(sin, cos) tables, each (T, rotary_dim//2), fp32.
+
+    Reference computed fixed sinusoids and rotated every-other dim
+    (``GPTJ.py:44-79``); we use the equivalent half-split rotation, which XLA
+    fuses into the surrounding matmuls without the interleaving gathers.
+    """
+    inv_freq = 1.0 / (
+        10000.0 ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim)
+    )
+    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rotary(t: jax.Array, sin: jax.Array, cos: jax.Array, rotary_dim: int):
+    """Rotate the first ``rotary_dim`` dims of ``t`` (..., T, D) by position."""
+    sin, cos = sin.astype(t.dtype), cos.astype(t.dtype)
+    t_rot, t_pass = t[..., :rotary_dim], t[..., rotary_dim:]
+    half = rotary_dim // 2
+    t1, t2 = t_rot[..., :half], t_rot[..., half:]
+    rotated = jnp.concatenate([t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1)
+    return jnp.concatenate([rotated, t_pass], axis=-1)
 
 
 def config_for(name: str, **overrides) -> GPT2Config:
@@ -85,8 +124,12 @@ def config_for(name: str, **overrides) -> GPT2Config:
 
 
 class Block(nn.Module):
-    """Pre-LN transformer block (parity with ``GPTJ.py:392-424`` structure,
-    standard GPT-2 residual wiring). Scan-compatible signature."""
+    """Pre-LN transformer block, scan-compatible signature.
+
+    Two residual wirings (parity with ``GPTJ.py:392-424``): sequential GPT-2
+    (ln_1 → attn, ln_2 → mlp) or, with ``parallel_residual=True``, GPT-J's
+    parallel form (one ln, attn and mlp added together). ``rotary=True``
+    rotates the first ``rotary_dim`` q/k dims by position."""
 
     cfg: GPT2Config
 
@@ -105,6 +148,16 @@ class Block(nn.Module):
             return t.reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
+        if cfg.rotary:
+            rd = cfg.rotary_dim or cfg.head_dim
+            if cfg.seq_axis is not None:
+                # Global positions for a sequence-sharded chunk.
+                offset = jax.lax.axis_index(cfg.seq_axis) * T
+            else:
+                offset = 0
+            sin, cos = rotary_sin_cos(jnp.arange(T) + offset, rd)
+            q = apply_rotary(q, sin, cos, rd)
+            k = apply_rotary(k, sin, cos, rd)
         if cfg.seq_axis is not None:
             from saturn_tpu.ops.ring import ring_attention
 
@@ -120,13 +173,22 @@ class Block(nn.Module):
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
-        x = x + nn.Dense(D, dtype=dt, param_dtype=pdt, name="attn_out")(attn)
+        attn = nn.Dense(D, dtype=dt, param_dtype=pdt, name="attn_out")(attn)
 
         # ---- mlp ----
-        h = nn.LayerNorm(dtype=dt, param_dtype=pdt, name="ln_2")(x)
-        h = nn.Dense(cfg.ff_dim, dtype=dt, param_dtype=pdt, name="mlp_in")(h)
-        h = nn.gelu(h, approximate=True)
-        x = x + nn.Dense(D, dtype=dt, param_dtype=pdt, name="mlp_out")(h)
+        def mlp(inp):
+            m = nn.Dense(cfg.ff_dim, dtype=dt, param_dtype=pdt, name="mlp_in")(inp)
+            m = nn.gelu(m, approximate=True)
+            return nn.Dense(D, dtype=dt, param_dtype=pdt, name="mlp_out")(m)
+
+        if cfg.parallel_residual:
+            # GPT-J wiring: attn and MLP both read ln_1(x), one residual add
+            # (reference ``GPTJ.py:392-424``).
+            x = x + attn + mlp(h)
+        else:
+            x = x + attn
+            h2 = nn.LayerNorm(dtype=dt, param_dtype=pdt, name="ln_2")(x)
+            x = x + mlp(h2)
         return x, None
 
 
@@ -145,20 +207,25 @@ class GPT2(nn.Module):
             (cfg.vocab_size, cfg.d_model),
             cfg.param_dtype,
         )
-        wpe = self.param(
-            "wpe",
-            nn.initializers.normal(0.01),
-            (cfg.seq_len, cfg.d_model),
-            cfg.param_dtype,
-        )
-        if cfg.seq_axis is not None:
-            # Local chunk of a sequence-sharded batch: positions offset by
-            # the shard index (T here is the per-shard chunk length).
-            offset = jax.lax.axis_index(cfg.seq_axis) * T
-            pos = jax.lax.dynamic_slice_in_dim(wpe, offset, T, axis=0)
+        if cfg.rotary:
+            # GPT-J: positions enter through rotary q/k rotation in each
+            # block; there is no learned position table (``GPTJ.py:271-338``).
+            x = wte[tokens].astype(cfg.dtype)
         else:
-            pos = wpe[:T]
-        x = wte[tokens].astype(cfg.dtype) + pos.astype(cfg.dtype)
+            wpe = self.param(
+                "wpe",
+                nn.initializers.normal(0.01),
+                (cfg.seq_len, cfg.d_model),
+                cfg.param_dtype,
+            )
+            if cfg.seq_axis is not None:
+                # Local chunk of a sequence-sharded batch: positions offset by
+                # the shard index (T here is the per-shard chunk length).
+                offset = jax.lax.axis_index(cfg.seq_axis) * T
+                pos = jax.lax.dynamic_slice_in_dim(wpe, offset, T, axis=0)
+            else:
+                pos = wpe[:T]
+            x = wte[tokens].astype(cfg.dtype) + pos.astype(cfg.dtype)
 
         block_cls = Block
         if cfg.remat:
@@ -185,7 +252,8 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
     """Model factory suitable for ``Task(get_model=...)``.
 
     Returns a ModelSpec whose params tree is
-    ``{'wte', 'wpe', 'blocks': {...leading layer axis...}, 'ln_f'}``.
+    ``{'wte', 'blocks': {...leading layer axis...}, 'ln_f'}`` plus ``'wpe'``
+    for non-rotary configs (rotary presets have no learned position table).
     """
     cfg = config_for(name, **overrides)
     module = GPT2(cfg)
@@ -202,10 +270,10 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
     # ``GPTJ.py:502-526``).
     def pipeline_embed(other_params, tokens):
         T = tokens.shape[-1]
-        return (
-            other_params["wte"][tokens].astype(cfg.dtype)
-            + other_params["wpe"][:T].astype(cfg.dtype)
-        )
+        x = other_params["wte"][tokens].astype(cfg.dtype)
+        if not cfg.rotary:
+            x = x + other_params["wpe"][:T].astype(cfg.dtype)
+        return x
 
     def pipeline_block(layer_params, x):
         y, _ = Block(cfg).apply({"params": layer_params}, x, None)
@@ -220,7 +288,7 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
     hints = {
         "block_param_key": "blocks",  # where the scanned layer stack lives
         "n_layers": cfg.n_layers,
-        "embed_param_keys": ("wte", "wpe"),
+        "embed_param_keys": ("wte",) if cfg.rotary else ("wte", "wpe"),
         "seq_parallel": True,  # factory accepts seq_axis/seq_axis_size
         "pipeline": {
             "embed": pipeline_embed,
@@ -231,3 +299,8 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         },
     }
     return ModelSpec(init_fn=init_fn, apply_fn=apply_fn, config=cfg, hints=hints)
+
+
+def build_gptj(name: str = "gptj-6b", **overrides) -> ModelSpec:
+    """GPT-J factory (rotary + parallel residual; reference ``GPTJ.py:271-390``)."""
+    return build_gpt2(name, **overrides)
