@@ -1,0 +1,70 @@
+"""Install-verification E2E smoke test (no TPU required).
+
+Parity target: ``examples/wikitext103/simple-verification.py:33-111`` — a
+``unittest.TestCase`` that registers techniques, builds one task restricted to
+specific apportionment sizes, runs the real ``search`` then ``orchestrate``,
+and asserts the job finished. Runs on 8 virtual CPU devices, so it exercises
+real multi-device pjit programs (SURVEY.md §4's "multi-node without a
+cluster" mode).
+
+Run:  python examples/lm_sweep/verify.py
+"""
+
+from __future__ import annotations
+
+import os
+import unittest
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+class VerifyInstall(unittest.TestCase):
+    """End-to-end: register → Task(chip_range=[4, 8]) → search → orchestrate
+    (reference ``simple-verification.py:59-73`` used gpu_range=[4, 8])."""
+
+    def setUp(self):
+        from saturn_tpu import HParams, Task, library
+        from saturn_tpu.data.lm_dataset import make_lm_dataset
+        from saturn_tpu.models.gpt2 import build_gpt2
+        from saturn_tpu.models.loss import pretraining_loss
+
+        library.register_default_library()
+        self.task = Task(
+            get_model=lambda **kw: build_gpt2("test-tiny", **kw),
+            get_dataloader=lambda: make_lm_dataset(
+                context_length=64, batch_size=8, vocab_size=256,
+                n_tokens=64 * 8 * 16,
+            ),
+            loss_fn=pretraining_loss,
+            hparams=HParams(lr=1e-3, batch_count=12),
+            chip_range=[4, 8],
+            name="verify-task",
+            save_dir="/tmp/saturn_verify_ckpts",
+        )
+        self.task.clear_ckpt()
+
+    def test_search_and_orchestrate(self):
+        import numpy as np
+
+        import saturn_tpu
+
+        saturn_tpu.search([self.task], technique_names=["dp", "fsdp"], log=True)
+        feasible = self.task.feasible_strategies()
+        self.assertTrue(feasible, "no feasible strategy found")
+        self.assertTrue(set(feasible) <= {4, 8}, f"chip_range ignored: {set(feasible)}")
+
+        saturn_tpu.orchestrate([self.task], log=True, interval=30.0)
+        self.assertEqual(self.task.total_batches, 0)
+        self.assertTrue(self.task.has_ckpt())
+        self.assertEqual(int(np.load(self.task.ckpt_path)["step"]), 12)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -164,6 +164,35 @@ class Task:
     def feasible_strategies(self) -> Dict[int, Strategy]:
         return {g: s for g, s in self.strategies.items() if s.feasible}
 
+    def clone(self, name: Optional[str] = None, **hparam_overrides) -> "Task":
+        """A new task sharing this one's factories and profiled strategies.
+
+        The reference deep-copied searched tasks to fan one profile out over
+        several learning rates without re-profiling (``WikiText103.py:87-99``)
+        — valid because lr doesn't change step time. Strategy objects are
+        copied (not aliased): ``forecast`` mutates remaining runtimes per task.
+        """
+        import copy
+        from dataclasses import replace as dc_replace
+
+        hp = dc_replace(self.hparams, **hparam_overrides) if hparam_overrides else copy.copy(self.hparams)
+        t = Task(
+            get_model=self._get_model,
+            # Feed the already-built dataset through so the eager epoch_length
+            # computation in __init__ doesn't re-tokenize per clone; the true
+            # factory is restored below.
+            get_dataloader=lambda: self.get_dataset(),
+            loss_fn=self.loss_fn,
+            hparams=hp,
+            chip_range=self.chip_range,
+            hints=dict(self.hints),
+            name=name,
+            save_dir=self.save_dir,
+        )
+        t._get_dataloader = self._get_dataloader
+        t.strategies = {g: copy.copy(s) for g, s in self.strategies.items()}
+        return t
+
     def __repr__(self) -> str:  # pragma: no cover
         return (
             f"Task(name={self.name!r}, total_batches={self.total_batches}, "

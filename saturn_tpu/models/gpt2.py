@@ -57,6 +57,15 @@ class GPT2Config:
     seq_axis_size: int = 1
     name: str = "gpt2-small"
 
+    def __post_init__(self) -> None:
+        if self.rotary:
+            rd = self.rotary_dim if self.rotary_dim is not None else self.head_dim
+            if rd % 2 != 0 or rd > self.head_dim:
+                raise ValueError(
+                    f"rotary_dim must be even and <= head_dim "
+                    f"({self.head_dim}), got {rd}"
+                )
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads

@@ -151,6 +151,24 @@ class TestTask:
         assert tiny_task.selected_strategy is s
         assert tiny_task.feasible_strategies() == {2: s}
 
+    def test_clone(self, tiny_task):
+        """lr fan-out without re-profiling (reference WikiText103.py:87-99)."""
+        tiny_task.strategies[2] = Strategy(object(), 2, {"remat": True}, 5.0,
+                                           per_batch_time=0.5)
+        c = tiny_task.clone(name="cloned", lr=3e-4)
+        assert c.name == "cloned" and c.hparams.lr == 3e-4
+        assert tiny_task.hparams.lr != 3e-4  # original untouched
+        # profile carried over, but Strategy objects are copies, not aliases:
+        # forecast mutates per-task remaining runtime.
+        assert c.strategies[2].runtime == 5.0
+        c.strategies[2].runtime = 1.0
+        assert tiny_task.strategies[2].runtime == 5.0
+        # dataset instance is shared (no re-tokenization per clone)...
+        assert c.get_dataset() is tiny_task.get_dataset()
+        assert c.epoch_length == tiny_task.epoch_length
+        # ...but the real factory is preserved for a fresh rebuild.
+        assert c._get_dataloader is tiny_task._get_dataloader
+
 
 class TestCheckpoint:
     def test_roundtrip_and_template_restore(self, tmp_path):
