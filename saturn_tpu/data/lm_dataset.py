@@ -83,6 +83,85 @@ def byte_tokenize_file(path: str, cache_dir: str = ".saturn_data_cache") -> np.n
     return tokens
 
 
+def _word_tokenize_python(data: bytes, max_vocab: int):
+    """Pure-Python fallback for the native tokenizer — byte-identical
+    semantics to ``tokenize.cpp``: operates on raw bytes, ASCII-only
+    lowercasing, ASCII-alnum runs are words, each non-space non-alnum byte is
+    its own token, frequency-ranked vocab, 0=pad 1=<unk>. (Multi-byte UTF-8
+    chars split into byte tokens on both paths, so native and fallback yield
+    the same id stream for any corpus.)"""
+    import re
+    from collections import Counter
+
+    toks = [
+        m.decode("latin-1")
+        for m in re.findall(rb"[a-z0-9]+|[^\sa-z0-9]", data.lower())
+    ]
+    counts = Counter(toks)
+    first = {}
+    for i, t in enumerate(toks):
+        first.setdefault(t, i)
+    ranked = sorted(counts, key=lambda t: (-counts[t], first[t]))[: max_vocab - 2]
+    vocab = {t: i + 2 for i, t in enumerate(ranked)}
+    ids = np.fromiter((vocab.get(t, 1) for t in toks), dtype=np.int32, count=len(toks))
+    return ids, len(vocab) + 2
+
+
+def word_tokenize_file(
+    path: str,
+    max_vocab: int = 32768,
+    cache_dir: str = ".saturn_data_cache",
+) -> tuple:
+    """Word-level tokenization of a local text file → (ids, vocab_size).
+
+    Native fast path: ``native/tokenize.cpp`` (the in-tree analog of the
+    reference's torchtext tokenizer+vocab pipeline, ``dataloaders.py:70-84``);
+    pure-Python fallback when no compiler is available. Results are cached as
+    ``.npz`` keyed on (path, max_vocab), exactly like the reference's cache.
+    """
+    import ctypes
+
+    from saturn_tpu import native
+
+    os.makedirs(cache_dir, exist_ok=True)
+    key = hashlib.sha1(
+        f"{os.path.abspath(path)}:{max_vocab}".encode()
+    ).hexdigest()[:16]
+    cache = os.path.join(cache_dir, f"words_{key}.npz")
+    if os.path.exists(cache):
+        with np.load(cache) as z:
+            return z["tokens"], int(z["vocab_size"])
+
+    lib = native.load("tokenize")
+    if lib is not None:
+        fn = lib.word_tokenize_file
+        fn.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        fn.restype = ctypes.c_long
+        p = path.encode()
+        n = fn(p, max_vocab, None, None, 0, None)
+        if n >= 0:
+            ids = np.empty(n, dtype=np.int32)
+            vs = ctypes.c_int()
+            vocab_path = os.path.join(cache_dir, f"vocab_{key}.txt")
+            got = fn(
+                p, max_vocab, vocab_path.encode(),
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n, ctypes.byref(vs),
+            )
+            if got == n:
+                np.savez(cache, tokens=ids, vocab_size=vs.value)
+                return ids, int(vs.value)
+
+    with open(path, "rb") as f:
+        ids, vocab_size = _word_tokenize_python(f.read(), max_vocab)
+    np.savez(cache, tokens=ids, vocab_size=vocab_size)
+    return ids, vocab_size
+
+
 def make_lm_dataset(
     context_length: int = 512,
     batch_size: int = 8,
@@ -90,15 +169,24 @@ def make_lm_dataset(
     n_tokens: Optional[int] = None,
     corpus_path: Optional[str] = None,
     seed: int = 0,
+    tokenizer: str = "byte",
 ) -> TokenDataset:
     """Dataloader factory for ``Task(get_dataloader=...)``.
 
-    Uses ``corpus_path`` (byte-tokenized local file, vocab must be >= 256) if
-    given and present, else a synthetic stream of ``n_tokens`` tokens
-    (default: enough for 64 batches).
+    Uses ``corpus_path`` if given and present — ``tokenizer="byte"`` (ids are
+    raw bytes; vocab must be >= 256) or ``tokenizer="word"`` (native
+    frequency-ranked word vocab capped at ``vocab_size``) — else a synthetic
+    stream of ``n_tokens`` tokens (default: enough for 64 batches).
     """
     if corpus_path and os.path.exists(corpus_path):
-        tokens = byte_tokenize_file(corpus_path)
+        if tokenizer == "word":
+            # vocab is *capped* at vocab_size (rare words -> <unk>), so the
+            # id range always fits the model's embedding table.
+            tokens, _ = word_tokenize_file(corpus_path, max_vocab=vocab_size)
+        elif tokenizer == "byte":
+            tokens = byte_tokenize_file(corpus_path)
+        else:
+            raise ValueError(f"unknown tokenizer {tokenizer!r} (byte|word)")
     else:
         if n_tokens is None:
             n_tokens = context_length * batch_size * 64

@@ -1,0 +1,64 @@
+"""Native (C++) runtime components, ctypes-bound.
+
+The reference's native capability was all external (Gurobi/CBC, Ray's C++
+core — SURVEY.md §2.2); here the in-tree native layer is built from source
+on first use with the system toolchain and loaded via ctypes (pybind11 is
+not in-image). Every native entry point has a pure-Python fallback, so the
+framework works — slower — if no compiler is available.
+
+Components:
+- ``libspase``   — SPASE list-scheduler + local search (``spase.cpp``)
+- ``libtokenize`` — corpus tokenizer/chunker (``tokenize.cpp``)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("saturn_tpu")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _ensure_built(name: str) -> Optional[str]:
+    """Compile ``<name>.cpp`` → ``_build/lib<name>.so`` if missing/stale."""
+    src = os.path.join(_DIR, f"{name}.cpp")
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    if not os.path.exists(src):
+        # source-less install (only prebuilt artifacts shipped): use the .so
+        # if present, else fall back to Python.
+        return out if os.path.exists(out) else None
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native build of %s failed (%r); using Python fallback", name, e)
+        return None
+    return out
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Build-if-needed and dlopen ``lib<name>.so``; None if unavailable."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        path = _ensure_built(name)
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError as e:
+                log.warning("dlopen(%s) failed: %r", path, e)
+        _CACHE[name] = lib
+        return lib

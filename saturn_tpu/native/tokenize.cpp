@@ -1,0 +1,123 @@
+// Native corpus tokenizer: word-level vocabulary build + encode in one pass.
+//
+// The reference's data pipeline tokenized WikiText with torchtext's native
+// tokenizer/vocab machinery and cached the id stream
+// (examples/wikitext103/dataloaders/dataloaders.py:70-84). This is the
+// in-tree native equivalent: lowercase word/punctuation split, frequency-
+// ranked vocabulary capped at max_vocab (id 0 = pad, 1 = <unk>), greedy
+// encode of every token to int32 ids. The Python side caches the result as
+// .npz, so this runs once per corpus.
+//
+// Protocol (ctypes-friendly): call with out_ids == NULL to get the required
+// token count; allocate; call again to fill. Negative returns are errors.
+
+#include <algorithm>
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+bool read_file(const char* path, std::string& out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  const long n = std::ftell(f);
+  if (n < 0) {
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  out.resize(static_cast<size_t>(n));
+  const size_t got = n ? std::fread(&out[0], 1, static_cast<size_t>(n), f) : 0;
+  std::fclose(f);
+  return got == static_cast<size_t>(n);
+}
+
+// Lowercased word (alnum run) / single punctuation-char tokens.
+void split_tokens(const std::string& text, std::vector<std::string>& toks) {
+  std::string cur;
+  for (unsigned char c : text) {
+    if (std::isalnum(c)) {
+      cur.push_back(static_cast<char>(std::tolower(c)));
+    } else {
+      if (!cur.empty()) {
+        toks.push_back(cur);
+        cur.clear();
+      }
+      if (!std::isspace(c)) toks.emplace_back(1, static_cast<char>(c));
+    }
+  }
+  if (!cur.empty()) toks.push_back(cur);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the corpus token count (>= 0) or a negative error code
+// (-1 unreadable file, -2 bad args). When out_ids is non-NULL it must hold
+// out_capacity entries; encoding stops short if the capacity is too small.
+long word_tokenize_file(const char* path, int max_vocab,
+                        const char* vocab_out_path, int32_t* out_ids,
+                        long out_capacity, int* out_vocab_size) {
+  if (!path || max_vocab < 3) return -2;
+  std::string text;
+  if (!read_file(path, text)) return -1;
+
+  std::vector<std::string> toks;
+  split_tokens(text, toks);
+  const long n = static_cast<long>(toks.size());
+  if (!out_ids) return n;
+
+  // Frequency count, ranked descending (ties: first occurrence wins so the
+  // mapping is deterministic across runs).
+  std::unordered_map<std::string, std::pair<long, long>> freq;  // count, first
+  freq.reserve(toks.size() / 4 + 16);
+  for (long i = 0; i < n; ++i) {
+    auto it = freq.find(toks[i]);
+    if (it == freq.end())
+      freq.emplace(toks[i], std::make_pair(1L, i));
+    else
+      ++it->second.first;
+  }
+  std::vector<const std::pair<const std::string, std::pair<long, long>>*> ranked;
+  ranked.reserve(freq.size());
+  for (const auto& kv : freq) ranked.push_back(&kv);
+  std::sort(ranked.begin(), ranked.end(), [](const auto* a, const auto* b) {
+    if (a->second.first != b->second.first)
+      return a->second.first > b->second.first;
+    return a->second.second < b->second.second;
+  });
+
+  const size_t keep =
+      std::min(ranked.size(), static_cast<size_t>(max_vocab - 2));
+  std::unordered_map<std::string, int32_t> vocab;
+  vocab.reserve(keep * 2);
+  for (size_t r = 0; r < keep; ++r)
+    vocab.emplace(ranked[r]->first, static_cast<int32_t>(r + 2));
+  if (out_vocab_size) *out_vocab_size = static_cast<int>(keep + 2);
+
+  if (vocab_out_path && vocab_out_path[0]) {
+    FILE* vf = std::fopen(vocab_out_path, "wb");
+    if (vf) {
+      std::fputs("<pad>\n<unk>\n", vf);
+      for (size_t r = 0; r < keep; ++r)
+        std::fprintf(vf, "%s\n", ranked[r]->first.c_str());
+      std::fclose(vf);
+    }
+  }
+
+  const long m = std::min(n, out_capacity);
+  for (long i = 0; i < m; ++i) {
+    auto it = vocab.find(toks[i]);
+    out_ids[i] = (it == vocab.end()) ? 1 : it->second;
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -74,16 +74,39 @@ def solve(
     topology: SliceTopology,
     time_limit: Optional[float] = None,
     ordering_slack: float = 1.0,
+    milp_task_limit: int = 12,
 ) -> Plan:
     """Build and solve the joint strategy/placement/schedule MILP.
 
     Each task contributes its *feasible* strategies (``params is not None`` —
     the reference's dummy-strategy exclusion, ``PerformanceEvaluator.py:96-110``).
     Tasks with no feasible strategy raise — better than silently dropping.
+
+    Above ``milp_task_limit`` tasks, the exact MILP's pairwise big-M
+    constraints explode (O(N²·devices) rows); the native C++ scheduler
+    (``native/spase.cpp``) takes over — same option set, validated plan.
     """
     for t in task_list:
         if not t.feasible_strategies():
             raise ValueError(f"task {t.name} has no feasible strategy; run search first")
+        if all(size > topology.capacity for size in t.feasible_strategies()):
+            raise ValueError(
+                f"task {t.name}: no strategy fits topology capacity {topology.capacity}"
+            )
+
+    if len(task_list) > milp_task_limit:
+        from saturn_tpu.solver import native_sched
+
+        plan = native_sched.solve_native(
+            task_list, topology,
+            time_limit=min(time_limit or 5.0, 5.0),
+            ordering_slack=ordering_slack,
+        )
+        if plan is not None:
+            log.info("large batch (%d tasks): native scheduler makespan %.1fs",
+                     len(task_list), plan.makespan)
+            return plan
+        return greedy_plan(task_list, topology)
 
     m = Model("spase")
     # Joint (strategy,block) choice per task.
@@ -96,10 +119,6 @@ def solve(
                 continue
             for blk in topology.blocks(size):
                 opts.append((size, blk, strat.runtime))
-        if not opts:
-            raise ValueError(
-                f"task {t.name}: no strategy fits topology capacity {topology.capacity}"
-            )
         choices[t.name] = opts
         x[t.name] = [m.binary(f"x_{t.name}_{s}_{b.offset}") for s, b, _ in opts]
         m.add(sum(x[t.name][1:], Expr.of(x[t.name][0])) == 1)
@@ -176,8 +195,13 @@ def solve(
 
     res = m.solve(time_limit=time_limit)
     if not res.ok:
-        log.warning("MILP infeasible/error — falling back to greedy schedule")
-        return greedy_plan(task_list, topology)
+        from saturn_tpu.solver import native_sched
+
+        log.warning("MILP infeasible/error — falling back to native/greedy")
+        plan = native_sched.solve_native(
+            task_list, topology, time_limit=1.0, ordering_slack=ordering_slack
+        )
+        return plan if plan is not None else greedy_plan(task_list, topology)
 
     assignments: Dict[str, Assignment] = {}
     for t in task_list:
@@ -231,7 +255,10 @@ def greedy_plan(task_list: List, topology: SliceTopology) -> Plan:
                 fin = st + strat.runtime
                 if best is None or fin < best[0]:
                     best = (fin, st, size, blk, strat.runtime)
-        assert best is not None
+        if best is None:
+            raise ValueError(
+                f"task {t.name}: no strategy fits topology capacity {topology.capacity}"
+            )
         fin, st, size, blk, rt = best
         for d in range(blk.offset, blk.end):
             events[d].append((st, fin))

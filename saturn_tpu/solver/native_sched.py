@@ -1,0 +1,132 @@
+"""ctypes binding for the native SPASE scheduler (``native/spase.cpp``).
+
+Drop-in producer of the same ``Plan`` the MILP emits. The caller picks when
+to use it (large batches; MILP timeout fallback); plans are validated here —
+device-overlap or misalignment rejects the native result, so a native bug can
+never produce an unsound schedule, only a fallback to the Python greedy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from saturn_tpu import native
+from saturn_tpu.core.mesh import Block, SliceTopology
+
+log = logging.getLogger("saturn_tpu")
+
+_FN = None
+
+
+def _fn():
+    """Resolve and type the ``spase_solve`` symbol once."""
+    global _FN
+    if _FN is None:
+        lib = native.load("spase")
+        if lib is None:
+            _FN = False
+        else:
+            f = lib.spase_solve
+            ip = ctypes.POINTER(ctypes.c_int)
+            dp = ctypes.POINTER(ctypes.c_double)
+            f.argtypes = [
+                ctypes.c_int, ip, ip, ip, dp,
+                ctypes.c_int, ctypes.c_double, ctypes.c_double,
+                ctypes.c_uint64, ip, dp, dp,
+            ]
+            f.restype = ctypes.c_int
+            _FN = f
+    return _FN or None
+
+
+def available() -> bool:
+    return _fn() is not None
+
+
+def solve_native(
+    task_list: List,
+    topology: SliceTopology,
+    time_limit: float = 1.0,
+    ordering_slack: float = 1.0,
+    seed: int = 0,
+):
+    """Schedule via libspase; returns a ``Plan`` or None if unavailable.
+
+    Builds the identical option set the MILP enumerates (feasible strategies
+    × aligned blocks, ``milp.solve``), calls the C++ core, validates, decodes.
+    """
+    from saturn_tpu.solver.milp import Assignment, Plan
+
+    fn = _fn()
+    if fn is None:
+        return None
+
+    counts, offs, sizes, rts = [], [], [], []
+    per_task: List[List[Tuple[int, Block, float]]] = []
+    for t in task_list:
+        opts = []
+        for size, strat in sorted(t.feasible_strategies().items()):
+            if size > topology.capacity:
+                continue
+            for blk in topology.blocks(size):
+                opts.append((size, blk, strat.runtime))
+        if not opts:
+            return None  # same contract as milp.solve's ValueError path
+        per_task.append(opts)
+        counts.append(len(opts))
+        for s, b, rt in opts:
+            offs.append(b.offset)
+            sizes.append(s)
+            rts.append(rt)
+
+    n = len(task_list)
+    c_counts = (ctypes.c_int * n)(*counts)
+    c_offs = (ctypes.c_int * len(offs))(*offs)
+    c_sizes = (ctypes.c_int * len(sizes))(*sizes)
+    c_rts = (ctypes.c_double * len(rts))(*rts)
+    c_chosen = (ctypes.c_int * n)()
+    c_starts = (ctypes.c_double * n)()
+    c_mk = ctypes.c_double()
+
+    rc = fn(
+        n, c_counts, c_offs, c_sizes, c_rts, topology.capacity,
+        float(time_limit), float(ordering_slack), seed,
+        c_chosen, c_starts, ctypes.byref(c_mk),
+    )
+    if rc != 0:
+        log.warning("libspase returned %d — falling back", rc)
+        return None
+
+    assignments: Dict[str, Assignment] = {}
+    for i, t in enumerate(task_list):
+        size, blk, rt = per_task[i][c_chosen[i]]
+        assignments[t.name] = Assignment(
+            apportionment=size, block=blk, start=float(c_starts[i]), runtime=rt
+        )
+    plan = Plan(assignments=assignments, makespan=float(c_mk.value))
+    if not _valid(plan, topology, ordering_slack):
+        log.warning("libspase plan failed validation — falling back")
+        return None
+    plan.compute_dependencies()
+    return plan
+
+
+def _valid(plan, topology: SliceTopology, slack: float) -> bool:
+    """No two tasks may overlap in time on any shared device."""
+    items = list(plan.assignments.values())
+    for i, a in enumerate(items):
+        if a.start < -1e-9 or a.block.end > topology.capacity:
+            return False
+        for b in items[i + 1 :]:
+            if not a.block.overlaps(b.block):
+                continue
+            if (a.start + a.runtime <= b.start + 1e-6) or (
+                b.start + b.runtime <= a.start + 1e-6
+            ):
+                continue
+            return False
+    return True

@@ -1,0 +1,178 @@
+"""Native (C++) components: SPASE scheduler and corpus tokenizer.
+
+These run without hardware; the toolchain (g++) is in-image, so the native
+path is expected to build. Fallback behavior is tested by monkeypatching the
+loader, not by uninstalling the compiler.
+"""
+
+import numpy as np
+import pytest
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.solver import milp, native_sched
+
+
+class FakeTask:
+    def __init__(self, name, strategies):
+        self.name = name
+        self.strategies = strategies
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+
+def mk_task(name, table):
+    """table: {size: runtime}"""
+    return FakeTask(
+        name,
+        {g: Strategy(object(), g, {}, rt, per_batch_time=rt) for g, rt in table.items()},
+    )
+
+
+def topo8():
+    return SliceTopology(devices=list(range(8)))
+
+
+def check_plan_valid(plan, capacity=8):
+    items = list(plan.assignments.values())
+    for i, a in enumerate(items):
+        assert a.start >= -1e-9
+        assert a.block.end <= capacity
+        for b in items[i + 1 :]:
+            if a.block.overlaps(b.block):
+                assert (
+                    a.start + a.runtime <= b.start + 1e-6
+                    or b.start + b.runtime <= a.start + 1e-6
+                ), "overlapping tasks share devices"
+
+
+class TestNativeScheduler:
+    def test_available(self):
+        assert native_sched.available(), "libspase failed to build"
+
+    def test_small_instance_valid_and_tight(self):
+        # 4 tasks that perfectly pack 8 devices in parallel -> makespan 10.
+        tasks = [mk_task(f"t{i}", {2: 10.0, 4: 6.0}) for i in range(4)]
+        plan = native_sched.solve_native(tasks, topo8(), time_limit=0.5)
+        assert plan is not None
+        check_plan_valid(plan)
+        # optimum: all four run 2-chip in parallel -> makespan 10 (the greedy
+        # constructor's myopic 4-chip pick gives 13; option-pinning moves in
+        # the local search must find the parallel packing).
+        assert plan.makespan <= 10.0 + 1e-6
+        assert set(plan.assignments) == {f"t{i}" for i in range(4)}
+
+    def test_never_worse_than_python_greedy(self):
+        rng = np.random.default_rng(7)
+        for trial in range(5):
+            tasks = []
+            for i in range(8):
+                sizes = [1, 2, 4]
+                tasks.append(
+                    mk_task(
+                        f"t{trial}_{i}",
+                        {s: float(rng.uniform(1, 20)) for s in sizes},
+                    )
+                )
+            # ordering_slack=0 to match greedy_plan's unpadded packing
+            nat = native_sched.solve_native(
+                tasks, topo8(), time_limit=0.3, ordering_slack=0.0
+            )
+            gre = milp.greedy_plan(tasks, topo8())
+            assert nat is not None
+            check_plan_valid(nat)
+            assert nat.makespan <= gre.makespan + 1e-6
+
+    def test_large_batch_routes_to_native(self):
+        tasks = [mk_task(f"t{i}", {1: 5.0, 2: 3.0}) for i in range(16)]
+        plan = milp.solve(tasks, topo8(), time_limit=2.0)
+        check_plan_valid(plan)
+        assert len(plan.assignments) == 16
+        # 16 tasks on 8 devices, each >= 3s of 2-chip work (or 5s 1-chip):
+        # lower bound on makespan is total_work/8 = 16*5/8 = 10 for 1-chip
+        # or 16*6/8 = 12 for 2-chip; just require a sane, finite result.
+        assert 0 < plan.makespan < 200
+
+    def test_capacity_error_names_task_large_batch(self):
+        """A task profiled only above capacity must raise the clear ValueError
+        on the native large-batch path too, not an opaque greedy crash."""
+        tasks = [mk_task(f"t{i}", {1: 5.0}) for i in range(13)]
+        tasks.append(mk_task("too-big", {16: 5.0}))
+        with pytest.raises(ValueError, match="too-big"):
+            milp.solve(tasks, topo8(), time_limit=1.0)
+
+    def test_fallback_when_native_missing(self, monkeypatch):
+        monkeypatch.setattr(native_sched, "_FN", False)
+        assert native_sched.solve_native([], topo8()) is None
+        tasks = [mk_task(f"t{i}", {1: 5.0}) for i in range(14)]
+        plan = milp.solve(tasks, topo8(), time_limit=1.0)  # > milp_task_limit
+        check_plan_valid(plan)
+        assert len(plan.assignments) == 14
+        monkeypatch.setattr(native_sched, "_FN", None)  # reset lazy cache
+
+
+SAMPLE = """The quick brown fox jumps over the lazy dog.
+The dog, surprisingly, did not mind; the fox did it again!
+"""
+
+
+class TestNativeTokenizer:
+    def test_native_matches_python(self, tmp_path):
+        from saturn_tpu.data.lm_dataset import _word_tokenize_python, word_tokenize_file
+
+        p = tmp_path / "corpus.txt"
+        p.write_text(SAMPLE * 3)
+        ids, vocab = word_tokenize_file(str(p), max_vocab=64, cache_dir=str(tmp_path / "c1"))
+        py_ids, py_vocab = _word_tokenize_python((SAMPLE * 3).encode(), 64)
+        assert vocab == py_vocab
+        np.testing.assert_array_equal(ids, py_ids)
+        assert ids.dtype == np.int32
+        # 'the' is the most frequent token -> id 2 (after pad/unk)
+        assert ids[0] == 2
+
+    def test_non_ascii_parity(self, tmp_path):
+        """Multi-byte UTF-8 must tokenize identically on both paths (bytes
+        split into single-byte tokens; ASCII-only lowercasing)."""
+        from saturn_tpu.data.lm_dataset import _word_tokenize_python, word_tokenize_file
+
+        text = "Café déjà-vu naïve Straße — twice! Café déjà-vu.\n" * 4
+        p = tmp_path / "utf8.txt"
+        p.write_text(text, encoding="utf-8")
+        ids, vocab = word_tokenize_file(str(p), max_vocab=128, cache_dir=str(tmp_path / "cx"))
+        py_ids, py_vocab = _word_tokenize_python(text.encode("utf-8"), 128)
+        assert vocab == py_vocab
+        np.testing.assert_array_equal(ids, py_ids)
+
+    def test_unk_capping(self, tmp_path):
+        from saturn_tpu.data.lm_dataset import word_tokenize_file
+
+        p = tmp_path / "corpus.txt"
+        p.write_text(SAMPLE)
+        ids, vocab = word_tokenize_file(str(p), max_vocab=5, cache_dir=str(tmp_path / "c2"))
+        assert vocab == 5
+        assert (ids == 1).any()  # rare tokens mapped to <unk>
+        assert ids.max() <= 4
+
+    def test_cache_hit(self, tmp_path):
+        from saturn_tpu.data.lm_dataset import word_tokenize_file
+
+        p = tmp_path / "corpus.txt"
+        p.write_text(SAMPLE)
+        cache = str(tmp_path / "c3")
+        a, va = word_tokenize_file(str(p), max_vocab=64, cache_dir=cache)
+        b, vb = word_tokenize_file(str(p), max_vocab=64, cache_dir=cache)
+        np.testing.assert_array_equal(a, b)
+        assert va == vb
+
+    def test_dataset_integration(self, tmp_path):
+        from saturn_tpu.data.lm_dataset import make_lm_dataset
+
+        p = tmp_path / "corpus.txt"
+        p.write_text(SAMPLE * 40)
+        ds = make_lm_dataset(
+            context_length=16, batch_size=4, vocab_size=128,
+            corpus_path=str(p), tokenizer="word",
+        )
+        b = ds.batch(0)
+        assert b.shape == (4, 16) and b.dtype == np.int32
