@@ -35,6 +35,11 @@ class ModelSpec:
     apply_fn: Callable[[Any, Any], Any]
     config: Any
     hints: Dict[str, Any] = field(default_factory=dict)
+    # Optional: ``(params, inputs) -> (logits, aux_loss)`` for models with an
+    # auxiliary training loss (e.g. MoE load balancing); techniques that know
+    # about it (parallel/ep.py) add ``aux_loss`` to the objective, everything
+    # else uses the plain ``apply_fn``.
+    apply_with_aux_fn: Optional[Callable[[Any, Any], Tuple[Any, Any]]] = None
 
     def abstract_init(self):
         import jax

@@ -49,12 +49,21 @@ class GPT2Config:
     rotary: bool = False
     rotary_dim: Optional[int] = None  # default: full head_dim
     parallel_residual: bool = False
+    # Mixture-of-experts: replace the dense MLP with a Switch-routed expert
+    # MLP (ops/moe.py). Aux load-balance loss is sown and surfaced via
+    # ``ModelSpec.apply_with_aux_fn``.
+    moe: bool = False
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
     # Sequence-parallel mode: name of the mesh axis the sequence is sharded
     # over. When set, the model must run inside shard_map — attention becomes
-    # ring attention (ops/ring.py) and positions are offset by the shard
-    # index. None = dense single-program attention.
+    # ring attention (ops/ring.py) or Ulysses all-to-all attention
+    # (ops/ulysses.py) per ``seq_mode``, and positions are offset by the
+    # shard index. None = dense single-program attention.
     seq_axis: Optional[str] = None
     seq_axis_size: int = 1
+    seq_mode: str = "ring"  # "ring" | "ulysses"
     name: str = "gpt2-small"
 
     def __post_init__(self) -> None:
@@ -97,6 +106,14 @@ PRESETS: Dict[str, Dict[str, Any]] = {
         d_model=64, n_layers=2, n_heads=4, vocab_size=256, seq_len=64,
         rotary=True, rotary_dim=8, parallel_residual=True,
     ),
+    # Switch-style MoE family (extension beyond the reference; SURVEY.md §2.3
+    # lists EP as absent there).
+    "moe-test-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, vocab_size=256, seq_len=64,
+        moe=True, n_experts=4, d_ff=128,
+    ),
+    "gpt2-small-moe8": dict(d_model=768, n_layers=12, n_heads=12, moe=True,
+                            n_experts=8),
 }
 
 
@@ -168,11 +185,18 @@ class Block(nn.Module):
             q = apply_rotary(q, sin, cos, rd)
             k = apply_rotary(k, sin, cos, rd)
         if cfg.seq_axis is not None:
-            from saturn_tpu.ops.ring import ring_attention
+            if cfg.seq_mode == "ulysses":
+                from saturn_tpu.ops.ulysses import ulysses_attention
 
-            attn = ring_attention(
-                q, k, v, axis_name=cfg.seq_axis, axis_size=cfg.seq_axis_size
-            )
+                attn = ulysses_attention(
+                    q, k, v, axis_name=cfg.seq_axis, axis_size=cfg.seq_axis_size
+                )
+            else:
+                from saturn_tpu.ops.ring import ring_attention
+
+                attn = ring_attention(
+                    q, k, v, axis_name=cfg.seq_axis, axis_size=cfg.seq_axis_size
+                )
         else:
             # fp32 softmax accumulation for stability; matmuls stay bf16-in.
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
@@ -184,8 +208,10 @@ class Block(nn.Module):
         attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
         attn = nn.Dense(D, dtype=dt, param_dtype=pdt, name="attn_out")(attn)
 
-        # ---- mlp ----
+        # ---- mlp (dense or Switch-routed experts) ----
         def mlp(inp):
+            if cfg.moe:
+                return self._moe_mlp(inp)
             m = nn.Dense(cfg.ff_dim, dtype=dt, param_dtype=pdt, name="mlp_in")(inp)
             m = nn.gelu(m, approximate=True)
             return nn.Dense(D, dtype=dt, param_dtype=pdt, name="mlp_out")(m)
@@ -199,6 +225,33 @@ class Block(nn.Module):
             h2 = nn.LayerNorm(dtype=dt, param_dtype=pdt, name="ln_2")(x)
             x = x + mlp(h2)
         return x, None
+
+    def _moe_mlp(self, inp):
+        """Expert MLP with explicit (E, ...) weight tables — the leading
+        expert axis is what the EP executor shards over the ``expert`` mesh
+        axis (dim 1 once the layer scan adds its leading axis)."""
+        from saturn_tpu.ops.moe import switch_moe
+
+        cfg = self.cfg
+        D, E, F = cfg.d_model, cfg.n_experts, cfg.ff_dim
+        pdt = cfg.param_dtype
+        init = nn.initializers.normal(0.02)
+        router_w = self.param("router", init, (D, E), pdt)
+        we_in = self.param("we_in", init, (E, D, F), pdt)
+        be_in = self.param("be_in", nn.initializers.zeros, (E, F), pdt)
+        we_out = self.param("we_out", init, (E, F, D), pdt)
+        be_out = self.param("be_out", nn.initializers.zeros, (E, D), pdt)
+        y, aux = switch_moe(
+            inp,
+            router_w.astype(cfg.dtype),
+            we_in.astype(cfg.dtype),
+            be_in.astype(cfg.dtype),
+            we_out.astype(cfg.dtype),
+            be_out.astype(cfg.dtype),
+            capacity_factor=cfg.capacity_factor,
+        )
+        self.sow("aux_loss", "moe_load_balance", aux)
+        return y
 
 
 class GPT2(nn.Module):
@@ -243,7 +296,7 @@ class GPT2(nn.Module):
             )
         stack = nn.scan(
             block_cls,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "aux_loss": 0},
             split_rngs={"params": True},
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
@@ -294,9 +347,21 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         logits = jnp.einsum("btd,vd->btv", xn, other_params["wte"].astype(cfg.dtype))
         return logits.astype(jnp.float32)
 
+    apply_with_aux_fn = None
+    if cfg.moe:
+
+        def apply_with_aux_fn(params, tokens):
+            logits, mut = module.apply(
+                {"params": params}, tokens, mutable=["aux_loss"]
+            )
+            aux_leaves = jax.tree.leaves(mut.get("aux_loss", {}))
+            aux = sum((jnp.sum(a) for a in aux_leaves), jnp.float32(0.0))
+            return logits, aux * cfg.moe_aux_weight
+
     hints = {
         "block_param_key": "blocks",  # where the scanned layer stack lives
         "n_layers": cfg.n_layers,
+        "moe": {"n_experts": cfg.n_experts} if cfg.moe else None,
         "embed_param_keys": ("wte",) if cfg.rotary else ("wte", "wpe"),
         "seq_parallel": True,  # factory accepts seq_axis/seq_axis_size
         "pipeline": {
@@ -307,7 +372,13 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
             "act_dtype": cfg.dtype,
         },
     }
-    return ModelSpec(init_fn=init_fn, apply_fn=apply_fn, config=cfg, hints=hints)
+    return ModelSpec(
+        init_fn=init_fn,
+        apply_fn=apply_fn,
+        config=cfg,
+        hints=hints,
+        apply_with_aux_fn=apply_with_aux_fn,
+    )
 
 
 def build_gptj(name: str = "gptj-6b", **overrides) -> ModelSpec:

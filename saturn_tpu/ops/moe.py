@@ -1,0 +1,83 @@
+"""Switch-style mixture-of-experts layer, built for the MXU.
+
+A capability extension beyond the reference (no MoE/expert parallelism exists
+anywhere in its tree — SURVEY.md §2.3 "EP ... absent"), delivered through the
+same plugin interface as every other technique (``parallel/ep.py``).
+
+TPU-first formulation (GShard/Switch): routing is expressed as dense one-hot
+dispatch/combine einsums with a *static* per-expert capacity, so the whole
+layer is three large batched matmuls plus elementwise — no dynamic shapes, no
+scatter/gather, everything tiles onto the systolic array. Under expert
+parallelism the (experts, capacity, d_model) intermediate is sharded over the
+``expert`` mesh axis and XLA lowers the dispatch/combine einsums to
+all-to-alls over ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(n_tokens: int, n_experts: int, capacity_factor: float) -> int:
+    """Static per-expert token budget (Switch Transformer's capacity)."""
+    return max(1, int(math.ceil(n_tokens / n_experts * capacity_factor)))
+
+
+def switch_moe(
+    x: jax.Array,
+    router_w: jax.Array,
+    we_in: jax.Array,
+    be_in: jax.Array,
+    we_out: jax.Array,
+    be_out: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 routed expert MLP.
+
+    Shapes: ``x`` (B, T, D); ``router_w`` (D, E); ``we_in`` (E, D, F);
+    ``be_in`` (E, F); ``we_out`` (E, F, D); ``be_out`` (E, D).
+    Returns (output (B, T, D), load-balance aux loss scalar fp32).
+
+    Tokens beyond an expert's capacity are dropped (contribute zero and pass
+    through the residual) — the standard Switch behavior that keeps shapes
+    static. Router math runs in fp32; expert matmuls in the input dtype.
+    """
+    B, T, D = x.shape
+    E = router_w.shape[-1]
+    S = B * T
+    xf = x.reshape(S, D)
+
+    logits = jnp.einsum(
+        "sd,de->se", xf, router_w, preferred_element_type=jnp.float32
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # (S, E) fp32
+    gate = probs.max(axis=-1)
+    expert = probs.argmax(axis=-1)
+
+    C = expert_capacity(S, E, capacity_factor)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)          # (S, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot                    # 1-based slot
+    keep = (pos > 0) & (pos <= C)
+    slot = jnp.clip(pos - 1, 0, C - 1)
+    dispatch = (
+        jax.nn.one_hot(slot, C, dtype=x.dtype)
+        * keep.astype(x.dtype)[..., None]
+    )                                                            # (S, E, C)
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]
+
+    xe = jnp.einsum("sec,sd->ecd", dispatch, xf)                 # (E, C, D)
+    h = jnp.einsum("ecd,edf->ecf", xe, we_in) + be_in[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    ye = jnp.einsum("ecf,efd->ecd", h, we_out) + be_out[:, None, :]
+    y = jnp.einsum("sec,ecd->sd", combine, ye)
+
+    # Switch load-balance loss: E * Σ_e (token fraction) * (mean router prob).
+    frac = onehot.astype(jnp.float32).mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return y.reshape(B, T, D), aux

@@ -1,0 +1,72 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence resharding.
+
+Complement to ring attention (``ops/ring.py``) for long-context training the
+reference lacks entirely (SURVEY.md §5). Where the ring rotates k/v blocks in
+S-1 neighbor hops, Ulysses (DeepSpeed-Ulysses, Jacobs et al. 2023) pays two
+all-to-alls per attention: reshard from sequence-sharded (every device holds
+all heads of its T/S chunk) to head-sharded (every device holds H/S heads of
+the FULL sequence), run plain dense causal attention locally, reshard back.
+
+Trade-off on the ICI torus: 2 all-to-alls of the qkv/out activations vs S-1
+ppermutes of k/v — Ulysses moves less data when S is large and H >= S, but
+holds full-T score blocks (O(T²/S) per device vs the ring's O(T²/S²)). Both
+ship as library techniques; the trial runner measures which wins per task.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = True,
+) -> jax.Array:
+    """Causal attention over a sequence-sharded batch via two all-to-alls.
+
+    Must be called inside ``shard_map``. ``q``/``k``/``v`` are local chunks of
+    shape (B, H, Tc, D) with Tc = T / axis_size; H must be divisible by
+    axis_size. Returns the local (B, H, Tc, D) attention output.
+    """
+    B, H, Tc, D = q.shape
+    S = axis_size
+    if H % S != 0:
+        raise ValueError(f"n_heads {H} not divisible by sequence axis {S}")
+
+    def reshard_in(t):
+        # (B, H, Tc, D) -> (B, H/S, T, D): split heads across devices,
+        # gather the full sequence for the local head subset.
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def reshard_out(t):
+        # (B, H/S, T, D) -> (B, H, Tc, D)
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    if S > 1:
+        q, k, v = reshard_in(q), reshard_in(k), reshard_in(v)
+
+    T = q.shape[2]
+    scores = (
+        jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+        / math.sqrt(D)
+    )
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+    if S > 1:
+        out = reshard_out(out)
+    return out

@@ -38,3 +38,17 @@ try:
     BUILTIN_TECHNIQUES["ring"] = RingSequenceParallel
 except ImportError:  # pragma: no cover
     pass
+
+try:
+    from saturn_tpu.parallel.ep import ExpertParallel
+
+    BUILTIN_TECHNIQUES["ep"] = ExpertParallel
+except ImportError:  # pragma: no cover
+    pass
+
+try:
+    from saturn_tpu.parallel.ulysses import UlyssesSequenceParallel
+
+    BUILTIN_TECHNIQUES["ulysses"] = UlyssesSequenceParallel
+except ImportError:  # pragma: no cover
+    pass

@@ -72,7 +72,9 @@ class HostOffload(SPMDTechnique):
     def candidate_configs(self, task, n_devices) -> List[Dict[str, Any]]:
         spec = task.get_model()
         grid: List[Dict[str, Any]] = []
-        if "pipeline" in spec.hints:
+        # Streaming replaces the forward pass, which would drop an aux loss;
+        # non-streaming configs below use spec.apply_fn and keep it.
+        if "pipeline" in spec.hints and not self._aux_incompatible(spec):
             # finest streaming first: lowest peak HBM, the configuration the
             # technique exists for (reference tried num_slices ascending,
             # ``Spilled.py:91-96``)

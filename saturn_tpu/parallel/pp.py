@@ -51,6 +51,8 @@ class Pipeline(SPMDTechnique):
         n_layers = getattr(spec.config, "n_layers", 1)
         if "pipeline" not in spec.hints:
             return []
+        if self._aux_incompatible(spec):
+            return []  # staged forward would drop the model's aux loss
         batch = task.get_dataset().batch_size
         grid: List[Dict[str, Any]] = []
         s = 2

@@ -52,6 +52,8 @@ class RingSequenceParallel(SPMDTechnique):
         spec = task.get_model()
         if not spec.hints.get("seq_parallel"):
             return []
+        if self._aux_incompatible(spec):
+            return []  # shard_map loss path would drop the model's aux loss
         ds = task.get_dataset()
         T = ds.context_length  # the dimension actually sharded over 'seq'
         grid: List[Dict[str, Any]] = []

@@ -116,16 +116,39 @@ class SPMDTechnique(BaseTechnique):
     def step_fns_from_forward(
         self, spec: Any, task: Any, forward: Any
     ) -> Tuple[Any, Any]:
-        """Standard loss/grad/optax scaffold around ``forward(params, batch)``."""
+        """Standard loss/grad/optax scaffold around ``forward(params, batch)``.
+
+        Models exposing an auxiliary training loss (``apply_with_aux_fn``,
+        e.g. MoE load balancing) get it added here, in the shared scaffold,
+        so the objective is identical no matter which technique the solver
+        picks for an interval. Techniques that replace the forward pass with
+        a custom schedule (pipeline, ring, offload streaming) must either
+        thread the aux loss themselves or declare aux models infeasible —
+        ``_aux_incompatible`` is the helper for that.
+        """
         loss_fn = task.loss_fn
+        use_aux = (
+            spec.apply_with_aux_fn is not None and forward is spec.apply_fn
+        )
 
         def loss_and_grads(params, batch):
             def loss_of(p):
+                if use_aux:
+                    logits, aux = spec.apply_with_aux_fn(p, batch)
+                    return loss_fn(logits, batch) + aux
                 return loss_fn(forward(p, batch), batch)
 
             return jax.value_and_grad(loss_of)(params)
 
         return self.step_fns_from_loss_and_grads(spec.init_fn, task, loss_and_grads)
+
+    @staticmethod
+    def _aux_incompatible(spec: Any) -> bool:
+        """True if the model carries an aux loss this technique's custom
+        forward path would silently drop — used by candidate_configs to
+        declare the (task × technique) pair infeasible, keeping the training
+        objective consistent across interval-boundary technique switches."""
+        return spec.apply_with_aux_fn is not None
 
     def step_fns_from_loss_and_grads(
         self, init_params: Any, task: Any, loss_and_grads: Any

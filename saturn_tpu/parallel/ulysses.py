@@ -1,0 +1,29 @@
+"""Sequence-parallel executor: Ulysses all-to-all over a ('data', 'seq') mesh.
+
+Sibling of :class:`RingSequenceParallel` — same mesh, same boundary-label
+loss, same plugin contract — but attention reshards with two all-to-alls to
+head-sharded full-sequence form (``ops/ulysses.py``) instead of rotating k/v
+around the ring. Requires ``n_heads % sp == 0``. The trial runner profiles
+both and the MILP picks whichever is faster for each task's shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from saturn_tpu.parallel.ring import RingSequenceParallel
+
+
+class UlyssesSequenceParallel(RingSequenceParallel):
+    name = "ulysses"
+
+    def candidate_configs(self, task, n_devices) -> List[Dict[str, Any]]:
+        grid = super().candidate_configs(task, n_devices)
+        spec = task.get_model()
+        n_heads = getattr(spec.config, "n_heads", 1)
+        return [c for c in grid if n_heads % c["sp"] == 0]
+
+    def _model_overrides(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = super()._model_overrides(config)
+        out["seq_mode"] = "ulysses"
+        return out

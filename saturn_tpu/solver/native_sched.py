@@ -116,7 +116,8 @@ def solve_native(
 
 
 def _valid(plan, topology: SliceTopology, slack: float) -> bool:
-    """No two tasks may overlap in time on any shared device."""
+    """Tasks sharing any device must be separated by >= slack (the same
+    separation the MILP's ordering constraints enforce)."""
     items = list(plan.assignments.values())
     for i, a in enumerate(items):
         if a.start < -1e-9 or a.block.end > topology.capacity:
@@ -124,8 +125,8 @@ def _valid(plan, topology: SliceTopology, slack: float) -> bool:
         for b in items[i + 1 :]:
             if not a.block.overlaps(b.block):
                 continue
-            if (a.start + a.runtime <= b.start + 1e-6) or (
-                b.start + b.runtime <= a.start + 1e-6
+            if (a.start + a.runtime + slack <= b.start + 1e-6) or (
+                b.start + b.runtime + slack <= a.start + 1e-6
             ):
                 continue
             return False

@@ -1,0 +1,187 @@
+"""Mixture-of-experts op, model, and expert-parallel executor tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from saturn_tpu.ops.moe import expert_capacity, switch_moe
+
+
+def dense_reference(x, router_w, we_in, be_in, we_out, be_out):
+    """Per-token loop reference: each token goes to its argmax expert (no
+    capacity drops), output scaled by the gate probability."""
+    B, T, D = x.shape
+    xf = x.reshape(-1, D)
+    logits = xf @ router_w
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = np.zeros_like(np.asarray(xf), dtype=np.float32)
+    for s in range(xf.shape[0]):
+        e = int(np.argmax(probs[s]))
+        h = np.asarray(xf[s]) @ np.asarray(we_in[e]) + np.asarray(be_in[e])
+        h = np.asarray(jax.nn.gelu(jnp.asarray(h), approximate=True))
+        y = h @ np.asarray(we_out[e]) + np.asarray(be_out[e])
+        out[s] = float(probs[s, e]) * y
+    return out.reshape(B, T, D)
+
+
+class TestSwitchMoe:
+    def _mk(self, B=2, T=8, D=16, E=4, F=32, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda *s: jnp.asarray(rng.standard_normal(s) * 0.1, jnp.float32)
+        return (
+            mk(B, T, D), mk(D, E), mk(E, D, F), mk(E, F), mk(E, F, D), mk(E, D),
+        )
+
+    def test_capacity(self):
+        assert expert_capacity(64, 4, 1.0) == 16
+        assert expert_capacity(64, 4, 1.25) == 20
+        assert expert_capacity(3, 8, 1.0) == 1
+
+    def test_matches_dense_routing(self):
+        x, rw, wi, bi, wo, bo = self._mk()
+        # capacity_factor big enough that nothing is dropped
+        y, aux = switch_moe(x, rw, wi, bi, wo, bo, capacity_factor=4.0)
+        ref = dense_reference(x, rw, wi, bi, wo, bo)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+        assert np.isfinite(float(aux)) and float(aux) > 0
+
+    def test_capacity_drops_tokens(self):
+        x, rw, wi, bi, wo, bo = self._mk()
+        # tiny capacity: most tokens dropped -> output much smaller in norm
+        y_full, _ = switch_moe(x, rw, wi, bi, wo, bo, capacity_factor=4.0)
+        y_tiny, _ = switch_moe(x, rw, wi, bi, wo, bo, capacity_factor=0.1)
+        assert np.linalg.norm(np.asarray(y_tiny)) < np.linalg.norm(np.asarray(y_full))
+
+    def test_aux_loss_balanced_is_one(self):
+        """Perfectly uniform routing gives aux = E * E * (1/E * 1/E) = 1."""
+        B, T, D, E = 1, 16, 8, 4
+        x = jnp.zeros((B, T, D))
+        rw = jnp.zeros((D, E))  # uniform probs; argmax ties -> expert 0
+        wi = jnp.zeros((E, D, 8)); bi = jnp.zeros((E, 8))
+        wo = jnp.zeros((E, 8, D)); bo = jnp.zeros((E, D))
+        _, aux = switch_moe(x, rw, wi, bi, wo, bo)
+        # all tokens on expert 0: aux = E * (1 * 1/E) = 1
+        np.testing.assert_allclose(float(aux), 1.0, rtol=1e-5)
+
+
+class TestMoeModel:
+    @pytest.fixture(scope="class")
+    def moe_spec(self):
+        from saturn_tpu.models.gpt2 import build_gpt2
+
+        return build_gpt2("moe-test-tiny")
+
+    def test_forward_and_aux(self, moe_spec):
+        cfg = moe_spec.config
+        params = moe_spec.init_fn(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, cfg.seq_len), 0, 255)
+        logits = moe_spec.apply_fn(params, tokens)  # plain path: sow is a no-op
+        assert logits.shape == (2, cfg.seq_len, cfg.vocab_size)
+        logits2, aux = moe_spec.apply_with_aux_fn(params, tokens)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2),
+                                   rtol=1e-5, atol=1e-5)
+        assert float(aux) > 0  # E * sum(f*P) >= 1 when weight > 0
+
+    def test_expert_tables_scanned(self, moe_spec):
+        cfg = moe_spec.config
+        shapes = moe_spec.abstract_init()
+        we_in = shapes["blocks"]["we_in"]
+        assert we_in.shape == (cfg.n_layers, cfg.n_experts, cfg.d_model, cfg.ff_dim)
+
+    def test_trains(self, moe_spec):
+        from tests.test_models import check_trains
+
+        check_trains(moe_spec)
+
+
+@pytest.fixture()
+def moe_task(tmp_path):
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    return Task(
+        get_model=lambda **kw: build_gpt2("moe-test-tiny", **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256, n_tokens=64 * 8 * 8
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=16),
+        save_dir=str(tmp_path / "ckpts"),
+    )
+
+
+class TestExpertParallel:
+    def test_search_execute_ckpt(self, moe_task, devices8):
+        from saturn_tpu.parallel.ep import ExpertParallel
+        from tests.test_executors import run_search_and_execute
+
+        run_search_and_execute(ExpertParallel(), moe_task, devices8[:4])
+
+    def test_expert_axis_sharded(self, moe_task, devices8):
+        from saturn_tpu.parallel.ep import ExpertParallel
+
+        tech = ExpertParallel()
+        bundle = tech.build(moe_task, devices8[:4], {"ep": 2, "remat": False})
+        sh = bundle.state_shardings["params"]["blocks"]["we_in"]
+        # positional: dim 0 is the layer scan, dim 1 is the expert axis
+        assert tuple(sh.spec)[1] == "expert", f"expert dim not sharded: {sh.spec}"
+        # router replicated
+        r = bundle.state_shardings["params"]["blocks"]["router"]
+        assert r.is_fully_replicated
+
+    def test_expert_rule_layer_collision(self):
+        """n_layers == n_experts must still shard dim 1, not the scan dim."""
+        from saturn_tpu.parallel.ep import expert_rules
+
+        rules = expert_rules("expert", 4)
+        spec = rules("params/blocks/we_in", (4, 4, 16, 32), {"expert": 2})
+        assert tuple(spec) == (None, "expert", None, None)
+        # unscanned table: expert dim is dim 0
+        spec0 = rules("params/we_in", (4, 16, 32), {"expert": 2})
+        assert tuple(spec0) == ("expert", None, None)
+
+    def test_objective_consistent_across_techniques(self, moe_task, devices8):
+        """Every standard technique must train the same objective (user loss
+        + aux) — interval-boundary technique switches must not change it."""
+        from saturn_tpu.models.loss import pretraining_loss
+        from saturn_tpu.parallel.dp import DataParallel
+        from saturn_tpu.parallel.ep import ExpertParallel
+
+        dp, ep = DataParallel(), ExpertParallel()
+        b_dp = dp.build(moe_task, devices8[:2], {"remat": False})
+        b_ep = ep.build(moe_task, devices8[:4], {"ep": 2, "remat": False})
+        s_dp, s_ep = b_dp.init(), b_ep.init()
+        batch = moe_task.batch_at(0)
+        _, l_dp = b_dp.step(s_dp, jax.device_put(batch, b_dp.batch_sharding))
+        _, l_ep = b_ep.step(s_ep, jax.device_put(batch, b_ep.batch_sharding))
+        np.testing.assert_allclose(float(l_dp), float(l_ep), rtol=2e-2)
+        # and both equal user loss + aux on the same init params
+        spec = moe_task.get_model()
+        params = spec.init_fn(jax.random.PRNGKey(0))
+        logits, aux = spec.apply_with_aux_fn(params, jnp.asarray(batch))
+        want = float(pretraining_loss(logits, jnp.asarray(batch))) + float(aux)
+        np.testing.assert_allclose(float(l_dp), want, rtol=2e-2)
+
+    def test_aux_dropping_techniques_infeasible(self, moe_task, devices8):
+        """pp/ring/offload-streaming replace the forward pass: they must
+        declare MoE (aux-loss) models infeasible rather than silently drop
+        the balancing term."""
+        from saturn_tpu.parallel.pp import Pipeline
+        from saturn_tpu.parallel.ring import RingSequenceParallel
+
+        assert Pipeline().candidate_configs(moe_task, 8) == []
+        assert RingSequenceParallel().candidate_configs(moe_task, 8) == []
+        from saturn_tpu.parallel.offload import HostOffload
+
+        assert all(
+            not c.get("stream") for c in HostOffload().candidate_configs(moe_task, 8)
+        )
+
+    def test_dense_model_infeasible(self, tiny_task, devices8):
+        from saturn_tpu.parallel.ep import ExpertParallel
+
+        params, t = ExpertParallel().search(tiny_task, devices8[:4], tid=0)
+        assert params is None and t is None

@@ -1,0 +1,68 @@
+"""Ulysses all-to-all sequence parallelism on the 8-virtual-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from saturn_tpu.ops.ulysses import ulysses_attention
+from tests.test_ring import dense_causal_attention
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_matches_dense(self, devices8, sp):
+        B, H, T, D = 2, 4, 32, 8
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jax.numpy.asarray(rng.normal(size=(B, H, T, D)), dtype=jax.numpy.float32)
+            for _ in range(3)
+        )
+        mesh = Mesh(np.array(devices8[:sp]), ("seq",))
+
+        def local(q, k, v):
+            return ulysses_attention(q, k, v, axis_name="seq", axis_size=sp)
+
+        mapped = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(None, None, "seq"),) * 3,
+            out_specs=P(None, None, "seq"),
+            check_vma=False,
+        )
+        out = jax.jit(mapped)(q, k, v)
+        ref = dense_causal_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_head_divisibility_enforced(self, devices8):
+        with pytest.raises(ValueError, match="not divisible"):
+            q = jax.numpy.zeros((1, 3, 8, 4))
+            ulysses_attention(q, q, q, axis_name="seq", axis_size=2)
+
+
+class TestUlyssesTechnique:
+    def test_search_execute_ckpt(self, tiny_task, devices8):
+        from saturn_tpu.parallel.ulysses import UlyssesSequenceParallel
+        from tests.test_executors import run_search_and_execute
+
+        run_search_and_execute(UlyssesSequenceParallel(), tiny_task, devices8[:4])
+
+    def test_matches_dp_loss(self, tiny_task, devices8):
+        from saturn_tpu.parallel.dp import DataParallel
+        from saturn_tpu.parallel.ulysses import UlyssesSequenceParallel
+
+        dp, ul = DataParallel(), UlyssesSequenceParallel()
+        b_dp = dp.build(tiny_task, devices8[:2], {"remat": False})
+        b_ul = ul.build(tiny_task, devices8[:4], {"sp": 4, "remat": False})
+        s_dp, s_ul = b_dp.init(), b_ul.init()
+        batch = tiny_task.batch_at(0)
+        _, l_dp = b_dp.step(s_dp, jax.device_put(batch, b_dp.batch_sharding))
+        _, l_ul = b_ul.step(s_ul, jax.device_put(batch, b_ul.batch_sharding))
+        np.testing.assert_allclose(float(l_dp), float(l_ul), rtol=2e-2)
+
+    def test_sp_capped_by_heads(self, tiny_task, devices8):
+        """test-tiny has 4 heads: sp=8 must not be proposed."""
+        from saturn_tpu.parallel.ulysses import UlyssesSequenceParallel
+
+        grid = UlyssesSequenceParallel().candidate_configs(tiny_task, 8)
+        assert grid and all(c["sp"] <= 4 for c in grid)
