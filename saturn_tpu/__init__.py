@@ -31,14 +31,16 @@ __all__ = [
 ]
 
 
-def search(tasks, technique_names=None, log=False, topology=None):
+def search(tasks, technique_names=None, log=False, topology=None, **kw):
     """Profile every (task × sub-mesh size × technique) combination.
 
     Reference: ``saturn/trial_runner/PerformanceEvaluator.py:33``.
     """
     from saturn_tpu.trial_runner.evaluator import search as _search
 
-    return _search(tasks, technique_names=technique_names, log=log, topology=topology)
+    return _search(
+        tasks, technique_names=technique_names, log=log, topology=topology, **kw
+    )
 
 
 def orchestrate(task_list, log=False, interval=1000, topology=None, **kw):

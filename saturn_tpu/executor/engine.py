@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.solver.milp import Plan
+from saturn_tpu.utils import metrics
 
 logger = logging.getLogger("saturn_tpu")
 
@@ -72,13 +73,21 @@ def execute(
     interval: float,
     plan: Plan,
     topology: SliceTopology,
-) -> None:
+    failure_policy: str = "raise",
+) -> Dict[str, BaseException]:
     """Gang-execute one interval (reference ``executor.py:88-129``).
 
     Per task: wait on dependency events (the MILP's ordering edges), run the
     selected technique on the assigned contiguous block, advance the data
     cursor, signal completion. Ends with a barrier + under/over-estimate log
     (``:123-129``).
+
+    ``failure_policy``: ``"raise"`` re-raises the first task failure after
+    the barrier (the reference's crash-the-batch behavior,
+    ``my_multiprocessing.py:108-176``); ``"drop"`` returns the failures so
+    the orchestrator can evict those tasks and keep the batch running —
+    failure isolation the reference lacks (SURVEY.md §5 "no elasticity").
+    Either way every other task finishes its interval first.
     """
     events = {t.name: threading.Event() for t in run_tasks}
     running = {t.name for t in run_tasks}
@@ -116,7 +125,14 @@ def execute(
     for th in threads:
         th.join()
     elapsed = timeit.default_timer() - t0
-    if errors:
+    metrics.event(
+        "interval",
+        elapsed_s=elapsed,
+        planned_s=interval,
+        n_tasks=len(run_tasks),
+        failed=sorted(errors),
+    )
+    if errors and failure_policy == "raise":
         name, err = next(iter(errors.items()))
         raise RuntimeError(f"interval execution failed for task {name}") from err
     # estimate-error feedback (``executor.py:126-129``)
@@ -124,3 +140,4 @@ def execute(
         logger.info("interval overran: %.1fs vs planned %.1fs", elapsed, interval)
     else:
         logger.info("interval finished early: %.1fs of %.1fs", elapsed, interval)
+    return errors

@@ -22,6 +22,7 @@ from typing import List, Optional
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.executor import engine
 from saturn_tpu.solver import milp
+from saturn_tpu.utils import metrics, trace
 
 logger = logging.getLogger("saturn_tpu")
 
@@ -33,15 +34,26 @@ def orchestrate(
     topology: Optional[SliceTopology] = None,
     threshold: float = 0.0,
     solver_time_limit: Optional[float] = None,
-) -> None:
+    failure_policy: str = "raise",
+    metrics_path: Optional[str] = None,
+    trace_dir: Optional[str] = None,
+) -> dict:
     """Run every task to completion, minimizing batch makespan.
 
     ``interval``: seconds of execution per scheduling round (reference default
     1000, ``orchestrator.py:32``). ``threshold``: makespan improvement needed
-    to adopt a re-solved plan (``milp.py:376-379``).
+    to adopt a re-solved plan (``milp.py:376-379``). ``failure_policy``:
+    ``"raise"`` (reference crash-the-batch semantics) or ``"drop"`` (evict
+    the failed task, keep the rest running). ``metrics_path`` appends JSONL
+    events (``utils/metrics.py``); ``trace_dir`` wraps the run in a
+    jax.profiler trace.
+
+    Returns ``{"completed": [names], "failed": {name: error string}}``.
     """
     if log:
         logging.basicConfig(level=logging.INFO)
+    if failure_policy not in ("raise", "drop"):
+        raise ValueError(f"failure_policy must be 'raise' or 'drop', got {failure_policy!r}")
     topo = topology if topology is not None else SliceTopology()
     names = [t.name for t in task_list]
     if len(set(names)) != len(names):
@@ -58,37 +70,62 @@ def orchestrate(
     tlimit = solver_time_limit if solver_time_limit is not None else interval / 2
 
     task_list = list(task_list)
-    plan = milp.solve(task_list, topo, time_limit=tlimit)  # initial blocking solve
-    logger.info("initial plan: makespan %.1fs, %d tasks", plan.makespan, len(task_list))
+    all_completed: List[str] = []
+    all_failed: dict = {}
+    with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
+        plan = milp.solve(task_list, topo, time_limit=tlimit)  # initial blocking solve
+        logger.info("initial plan: makespan %.1fs, %d tasks", plan.makespan, len(task_list))
+        metrics.event("solve", makespan_s=plan.makespan, n_tasks=len(task_list))
 
-    with ThreadPoolExecutor(max_workers=1, thread_name_prefix="solver") as pool:
-        while task_list:
-            run_tasks, batches, completed = engine.forecast(task_list, interval, plan)
-            remaining = [t for t in task_list if t not in completed]
+        with ThreadPoolExecutor(max_workers=1, thread_name_prefix="solver") as pool:
+            while task_list:
+                run_tasks, batches, completed = engine.forecast(task_list, interval, plan)
+                remaining = [t for t in task_list if t not in completed]
 
-            future = None
-            if remaining:
-                # overlap next-interval solve with this interval's execution
-                # (``orchestrator.py:69-71``)
-                future = pool.submit(
-                    milp.resolve, remaining, topo, plan, interval, threshold, tlimit
-                )
+                future = None
+                if remaining:
+                    # overlap next-interval solve with this interval's execution
+                    # (``orchestrator.py:69-71``)
+                    future = pool.submit(
+                        milp.resolve, remaining, topo, plan, interval, threshold, tlimit
+                    )
 
-            if run_tasks:
-                engine.execute(run_tasks, batches, interval, plan, topo)
-            elif remaining:
-                # nothing scheduled inside this interval (all starts beyond
-                # it): the slide in resolve() brings work forward next round.
-                logger.info("idle interval: no task starts within %.1fs", interval)
+                errors: dict = {}
+                if run_tasks:
+                    errors = engine.execute(
+                        run_tasks, batches, interval, plan, topo,
+                        failure_policy=failure_policy,
+                    )
+                elif remaining:
+                    # nothing scheduled inside this interval (all starts beyond
+                    # it): the slide in resolve() brings work forward next round.
+                    logger.info("idle interval: no task starts within %.1fs", interval)
 
-            for t in completed:
-                release = getattr(t, "release_live_state", None)
-                if release is not None:
-                    release()  # free HBM held by finished tasks
-            task_list = remaining
-            if future is not None:
-                plan = future.result()
-                logger.info(
-                    "re-solve: makespan %.1fs, %d tasks left", plan.makespan, len(task_list)
-                )
-    logger.info("orchestration complete")
+                if errors:  # failure_policy == "drop": evict failed tasks
+                    for name, err in errors.items():
+                        all_failed[name] = repr(err)
+                        metrics.event("task_failed", task=name, error=repr(err))
+                        logger.warning("evicting failed task %s: %r", name, err)
+                    remaining = [t for t in remaining if t.name not in errors]
+                    completed = [t for t in completed if t.name not in errors]
+
+                for t in completed:
+                    all_completed.append(t.name)
+                    metrics.event("task_completed", task=t.name)
+                    release = getattr(t, "release_live_state", None)
+                    if release is not None:
+                        release()  # free HBM held by finished tasks
+                task_list = remaining
+                if future is not None:
+                    plan = future.result()
+                    # Evictions happened after the solve was submitted: the
+                    # plan may still cover dropped tasks; their slots simply
+                    # idle for one interval and vanish at the next re-solve.
+                    logger.info(
+                        "re-solve: makespan %.1fs, %d tasks left",
+                        plan.makespan, len(task_list),
+                    )
+                    metrics.event("solve", makespan_s=plan.makespan, n_tasks=len(task_list))
+    logger.info("orchestration complete (%d completed, %d failed)",
+                len(all_completed), len(all_failed))
+    return {"completed": all_completed, "failed": all_failed}

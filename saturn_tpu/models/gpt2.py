@@ -67,6 +67,10 @@ class GPT2Config:
     name: str = "gpt2-small"
 
     def __post_init__(self) -> None:
+        if self.seq_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_mode must be 'ring' or 'ulysses', got {self.seq_mode!r}"
+            )
         if self.rotary:
             rd = self.rotary_dim if self.rotary_dim is not None else self.head_dim
             if rd % 2 != 0 or rd > self.head_dim:

@@ -101,7 +101,16 @@ class HostOffload(SPMDTechnique):
             def forward(params, batch):
                 return spec.apply_fn(_to_device(params), batch)
 
-            return self.step_fns_from_forward(spec, task, forward)
+            forward_with_aux = None
+            if spec.apply_with_aux_fn is not None:
+                # same staging wrapper, aux loss preserved (the scaffold's
+                # identity check can't see through the closure).
+                def forward_with_aux(params, batch):
+                    return spec.apply_with_aux_fn(_to_device(params), batch)
+
+            return self.step_fns_from_forward(
+                spec, task, forward, forward_with_aux=forward_with_aux
+            )
 
         # Streaming mode: per-layer fetch inside a scan over the stacked
         # block params (requires the model's pipeline decomposition hints).

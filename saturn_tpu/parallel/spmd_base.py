@@ -114,27 +114,29 @@ class SPMDTechnique(BaseTechnique):
         return self.step_fns_from_forward(spec, task, spec.apply_fn)
 
     def step_fns_from_forward(
-        self, spec: Any, task: Any, forward: Any
+        self, spec: Any, task: Any, forward: Any, forward_with_aux: Any = None
     ) -> Tuple[Any, Any]:
         """Standard loss/grad/optax scaffold around ``forward(params, batch)``.
 
         Models exposing an auxiliary training loss (``apply_with_aux_fn``,
         e.g. MoE load balancing) get it added here, in the shared scaffold,
         so the objective is identical no matter which technique the solver
-        picks for an interval. Techniques that replace the forward pass with
-        a custom schedule (pipeline, ring, offload streaming) must either
-        thread the aux loss themselves or declare aux models infeasible —
-        ``_aux_incompatible`` is the helper for that.
+        picks for an interval. A technique that wraps the forward pass but
+        preserves its semantics (bulk offload staging) passes its own
+        ``forward_with_aux`` wrapper; techniques that replace the schedule
+        outright (pipeline, ring, offload streaming) must declare aux models
+        infeasible instead — ``_aux_incompatible`` is the helper for that.
         """
         loss_fn = task.loss_fn
-        use_aux = (
+        if forward_with_aux is None and (
             spec.apply_with_aux_fn is not None and forward is spec.apply_fn
-        )
+        ):
+            forward_with_aux = spec.apply_with_aux_fn
 
         def loss_and_grads(params, batch):
             def loss_of(p):
-                if use_aux:
-                    logits, aux = spec.apply_with_aux_fn(p, batch)
+                if forward_with_aux is not None:
+                    logits, aux = forward_with_aux(p, batch)
                     return loss_fn(logits, batch) + aux
                 return loss_fn(forward(p, batch), batch)
 

@@ -25,6 +25,7 @@ from typing import List, Optional, Sequence
 from saturn_tpu import library as lib
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.utils import metrics, trace
 
 logger = logging.getLogger("saturn_tpu")
 
@@ -36,16 +37,24 @@ def search(
     technique_names: Optional[List[str]] = None,
     log: bool = False,
     topology: Optional[SliceTopology] = None,
+    metrics_path: Optional[str] = None,
+    trace_dir: Optional[str] = None,
 ) -> None:
     """Fill ``task.strategies`` for every task in place.
 
     ``technique_names=None`` uses the whole library (registering the built-in
     default library if the user registered nothing — the reference required
-    explicit registration, ``WikiText103.py:53-54``).
+    explicit registration, ``WikiText103.py:53-54``). ``metrics_path``
+    appends per-trial JSONL events; ``trace_dir`` wraps the sweep in a
+    jax.profiler trace.
     """
     if log:
         logging.basicConfig(level=logging.INFO)
+    with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
+        _search_inner(tasks, technique_names, topology)
 
+
+def _search_inner(tasks, technique_names, topology) -> None:
     topo = topology if topology is not None else SliceTopology()
     if technique_names is None and not lib.registered_names():
         lib.register_default_library()
@@ -75,8 +84,13 @@ def search(
         tid += 1
         if params is None or per_batch_time is None:
             logger.info("trial (%s, g=%d, %s): infeasible", task.name, g, name)
+            metrics.event("trial", task=task.name, size=g, technique=name,
+                          feasible=False)
             continue
         total = per_batch_time * task.total_batches  # reference ``:26``
+        metrics.event("trial", task=task.name, size=g, technique=name,
+                      feasible=True, per_batch_s=per_batch_time,
+                      est_total_s=total, params=params)
         logger.info(
             "trial (%s, g=%d, %s): %.4fs/batch, est total %.1fs (trial took %.1fs)",
             task.name, g, name, per_batch_time, total, timeit.default_timer() - t0,

@@ -1,0 +1,79 @@
+"""Structured metrics: append-only JSONL event stream.
+
+The reference's observability was prints + stdlib logging + the Ray dashboard
+(SURVEY.md §5 "Metrics / logging": "No metrics files, no TensorBoard"). This
+fills that gap with the smallest thing that composes: every subsystem emits
+typed events (trial results, interval timing/estimate error, solver
+makespans, task failures) to one JSONL file a notebook or `jq` can consume.
+
+Disabled unless configured — ``search(metrics_path=...)`` /
+``orchestrate(metrics_path=...)`` or :func:`configure` directly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Optional
+
+
+class MetricsWriter:
+    """Thread-safe JSONL appender (the engine launches tasks from threads)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1)
+
+    def event(self, kind: str, **fields) -> None:
+        rec = {"ts": time.time(), "kind": kind}
+        rec.update(fields)
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+
+_WRITER: Optional[MetricsWriter] = None
+_CONF_LOCK = threading.Lock()
+
+
+def configure(path: Optional[str]) -> None:
+    """Point the global metrics stream at ``path`` (None disables)."""
+    global _WRITER
+    with _CONF_LOCK:
+        if _WRITER is not None:
+            _WRITER.close()
+        _WRITER = MetricsWriter(path) if path else None
+
+
+def event(kind: str, **fields) -> None:
+    """Emit an event if metrics are configured; no-op otherwise."""
+    w = _WRITER
+    if w is not None:
+        w.event(kind, **fields)
+
+
+@contextlib.contextmanager
+def scoped(path: Optional[str]):
+    """Route events to ``path`` for the enclosed region, then restore the
+    previous sink and close the file — so ``orchestrate(metrics_path=...)``
+    cannot leak its writer into later runs."""
+    global _WRITER
+    if not path:
+        yield
+        return
+    with _CONF_LOCK:
+        prev = _WRITER
+        _WRITER = MetricsWriter(path)
+    try:
+        yield
+    finally:
+        with _CONF_LOCK:
+            _WRITER.close()
+            _WRITER = prev

@@ -1,0 +1,52 @@
+"""Profiler tracing: wrap a region in a jax.profiler trace.
+
+The reference had no tracer at all — profiling was wall-clock timing only
+(SURVEY.md §5 "Tracing / profiling: no tracer"). Here wall-clock timing stays
+the scheduling signal (``utils/timing.py``), and this adds the TPU-native
+deep-dive: XLA/TPU traces viewable in TensorBoard/Perfetto, produced by
+passing ``trace_dir=`` to ``search``/``orchestrate``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Iterator, Optional
+
+log = logging.getLogger("saturn_tpu")
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Trace the enclosed region to ``trace_dir`` (no-op when None)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    # The tunneled single-chip dev platform ("axon") wedges on profiler
+    # start_trace (the remote terminal stops answering — observed 2026-07);
+    # device tracing needs a directly-attached TPU runtime. Skip rather than
+    # hang the run.
+    if jax.devices()[0].platform == "axon":
+        log.warning("profiler tracing unsupported on the axon tunnel; skipping")
+        yield
+        return
+
+    # Tracing must never take down a training run: trace start/stop failures
+    # are logged and swallowed; exceptions from the traced body propagate.
+    started = False
+    try:
+        jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception as e:
+        log.warning("profiler trace failed to start (%r); continuing", e)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                log.info("profiler trace written to %s", trace_dir)
+            except Exception as e:
+                log.warning("profiler trace failed to stop (%r)", e)

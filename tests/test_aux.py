@@ -1,0 +1,162 @@
+"""Aux subsystems: metrics stream, profiler trace wrapper, failure isolation.
+
+These fill the gaps SURVEY.md §5 identifies in the reference (no metrics
+files, no tracer, no failure isolation — a child-process crash killed the
+whole batch).
+"""
+
+import json
+
+import pytest
+
+from saturn_tpu import HParams, Task, library
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.core.technique import BaseTechnique
+from saturn_tpu.utils import metrics
+from saturn_tpu.utils.trace import profile_trace
+
+
+def read_events(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+class TestMetrics:
+    def test_writer_and_global(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        metrics.configure(p)
+        try:
+            metrics.event("trial", task="a", feasible=True)
+            metrics.event("interval", elapsed_s=1.5)
+        finally:
+            metrics.configure(None)
+        evs = read_events(p)
+        assert [e["kind"] for e in evs] == ["trial", "interval"]
+        assert evs[0]["task"] == "a" and "ts" in evs[0]
+        # unconfigured -> no-op, no error
+        metrics.event("ignored")
+
+    def test_thread_safety(self, tmp_path):
+        import threading
+
+        p = str(tmp_path / "m.jsonl")
+        metrics.configure(p)
+        try:
+            ths = [
+                threading.Thread(
+                    target=lambda i=i: [metrics.event("e", i=i) for _ in range(50)]
+                )
+                for i in range(4)
+            ]
+            [t.start() for t in ths]
+            [t.join() for t in ths]
+        finally:
+            metrics.configure(None)
+        evs = read_events(p)  # every line must be valid JSON (no interleaving)
+        assert len(evs) == 200
+
+
+class TestTrace:
+    def test_noop_without_dir(self):
+        with profile_trace(None):
+            pass
+
+    def test_writes_trace(self, tmp_path):
+        import os
+
+        d = str(tmp_path / "trace")
+        with profile_trace(d):
+            import jax
+            import jax.numpy as jnp
+
+            jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+        # jax writes plugins/profile/<date>/ under the dir
+        assert os.path.isdir(d) and os.listdir(d)
+
+    def test_body_exception_propagates(self, tmp_path):
+        with pytest.raises(RuntimeError, match="boom"):
+            with profile_trace(str(tmp_path / "t2")):
+                raise RuntimeError("boom")
+
+
+class FlakyTechnique(BaseTechnique):
+    """Succeeds search; explodes on execute for tasks named 'bad*'."""
+
+    name = "flaky"
+
+    def search(self, task, devices, tid):
+        return {}, 0.01
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        if task.name.startswith("bad"):
+            raise RuntimeError(f"injected failure for {task.name}")
+        import numpy as np
+
+        np.savez(task.ckpt_path, step=override_batch_count or 0)
+
+
+def mk_task(name, tmp_path, batches=4):
+    t = Task(
+        get_model=lambda **kw: None,
+        get_dataloader=lambda: FakeDS(),
+        loss_fn=lambda a, b: 0.0,
+        hparams=HParams(lr=1e-3, batch_count=batches),
+        name=name,
+        save_dir=str(tmp_path / "ckpts"),
+    )
+    return t
+
+
+class FakeDS:
+    batch_size = 4
+    context_length = 8
+
+    def __len__(self):
+        return 4
+
+    def batch(self, i):
+        import numpy as np
+
+        return np.zeros((4, 8), dtype=np.int32)
+
+    def example_batch(self):
+        return self.batch(0)
+
+
+class TestFailureIsolation:
+    def _setup(self, tmp_path):
+        import saturn_tpu
+
+        library.register("flaky", FlakyTechnique)
+        good = mk_task("good-task", tmp_path)
+        bad = mk_task("bad-task", tmp_path)
+        tech = FlakyTechnique()
+        for t in (good, bad):
+            t.strategies[1] = Strategy(tech, 1, {}, 1.0, per_batch_time=0.01)
+        return saturn_tpu, good, bad
+
+    def test_drop_policy_evicts_and_continues(self, tmp_path):
+        saturn_tpu, good, bad = self._setup(tmp_path)
+        res = saturn_tpu.orchestrate(
+            [good, bad], interval=10.0, failure_policy="drop",
+            metrics_path=str(tmp_path / "m.jsonl"),
+        )
+        assert res["completed"] == ["good-task"]
+        assert "bad-task" in res["failed"]
+        kinds = [e["kind"] for e in read_events(str(tmp_path / "m.jsonl"))]
+        assert "task_failed" in kinds and "task_completed" in kinds
+        assert "solve" in kinds and "interval" in kinds
+        # the scoped writer must be restored on exit: later events are no-ops
+        n = len(kinds)
+        metrics.event("leak-check")
+        assert len(read_events(str(tmp_path / "m.jsonl"))) == n
+
+    def test_raise_policy_crashes_batch(self, tmp_path):
+        saturn_tpu, good, bad = self._setup(tmp_path)
+        with pytest.raises(RuntimeError, match="bad-task"):
+            saturn_tpu.orchestrate([good, bad], interval=10.0)
+
+    def test_invalid_policy_rejected(self, tmp_path):
+        saturn_tpu, good, _ = self._setup(tmp_path)
+        with pytest.raises(ValueError, match="failure_policy"):
+            saturn_tpu.orchestrate([good], interval=10.0, failure_policy="retry")

@@ -180,6 +180,23 @@ class TestExpertParallel:
             not c.get("stream") for c in HostOffload().candidate_configs(moe_task, 8)
         )
 
+    def test_bulk_offload_keeps_aux(self, moe_task, devices8):
+        """Bulk (non-streaming) offload wraps the forward pass but must still
+        train user loss + aux, matching every other standard technique."""
+        from saturn_tpu.models.loss import pretraining_loss
+        from saturn_tpu.parallel.offload import HostOffload
+
+        off = HostOffload()
+        b = off.build(moe_task, devices8[:2], {"stream": False, "remat": False})
+        state = b.init()
+        batch = moe_task.batch_at(0)
+        _, loss = b.step(state, jax.device_put(batch, b.batch_sharding))
+        spec = moe_task.get_model()
+        params = spec.init_fn(jax.random.PRNGKey(0))
+        logits, aux = spec.apply_with_aux_fn(params, jnp.asarray(batch))
+        want = float(pretraining_loss(logits, jnp.asarray(batch))) + float(aux)
+        np.testing.assert_allclose(float(loss), want, rtol=2e-2)
+
     def test_dense_model_infeasible(self, tiny_task, devices8):
         from saturn_tpu.parallel.ep import ExpertParallel
 
