@@ -28,6 +28,8 @@ class Techniques(enum.Enum):
     OFFLOAD = 4     # host-memory param/activation offload ("spilling")
     TENSOR = 5      # Megatron-style tensor parallelism over a `model` axis
     RING = 6        # sequence/context parallelism with ring attention
+    ULYSSES = 7     # sequence parallelism with all-to-all head resharding
+    EXPERT = 8      # expert parallelism for mixture-of-experts models
 
 
 @dataclass

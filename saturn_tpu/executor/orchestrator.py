@@ -106,6 +106,11 @@ def orchestrate(
                         all_failed[name] = repr(err)
                         metrics.event("task_failed", task=name, error=repr(err))
                         logger.warning("evicting failed task %s: %r", name, err)
+                    for t in run_tasks:
+                        if t.name in errors:
+                            release = getattr(t, "release_live_state", None)
+                            if release is not None:
+                                release()  # free HBM before the block is reused
                     remaining = [t for t in remaining if t.name not in errors]
                     completed = [t for t in completed if t.name not in errors]
 

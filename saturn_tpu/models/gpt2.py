@@ -64,12 +64,20 @@ class GPT2Config:
     seq_axis: Optional[str] = None
     seq_axis_size: int = 1
     seq_mode: str = "ring"  # "ring" | "ulysses"
+    # Single-program attention implementation: "dense" (XLA einsums) or
+    # "flash" (fused Pallas kernel, ops/flash.py). Ignored when seq_axis is
+    # set (sequence-parallel attention has its own kernels).
+    attention: str = "dense"
     name: str = "gpt2-small"
 
     def __post_init__(self) -> None:
         if self.seq_mode not in ("ring", "ulysses"):
             raise ValueError(
                 f"seq_mode must be 'ring' or 'ulysses', got {self.seq_mode!r}"
+            )
+        if self.attention not in ("dense", "flash"):
+            raise ValueError(
+                f"attention must be 'dense' or 'flash', got {self.attention!r}"
             )
         if self.rotary:
             rd = self.rotary_dim if self.rotary_dim is not None else self.head_dim
@@ -201,6 +209,10 @@ class Block(nn.Module):
                 attn = ring_attention(
                     q, k, v, axis_name=cfg.seq_axis, axis_size=cfg.seq_axis_size
                 )
+        elif cfg.attention == "flash":
+            from saturn_tpu.ops.flash import flash_attention
+
+            attn = flash_attention(q, k, v, causal=True)
         else:
             # fp32 softmax accumulation for stability; matmuls stay bf16-in.
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)

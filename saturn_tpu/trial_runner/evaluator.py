@@ -70,7 +70,11 @@ def _search_inner(tasks, technique_names, topology) -> None:
         for g in sizes:
             for name, tech in techniques:
                 grid.append((task, g, name, tech))
-    logger.info("trial runner: %d trials queued", len(grid))
+    # ETA estimate: compile dominates a trial; ~1 min upper bound per trial
+    # matches the reference's ~1.2 min rule of thumb (``:86-91``).
+    logger.info(
+        "trial runner: %d trials queued (≤ ~%.0f min)", len(grid), len(grid) * 1.0
+    )
 
     tid = 0
     for task, g, name, tech in grid:

@@ -68,12 +68,16 @@ def scoped(path: Optional[str]):
     if not path:
         yield
         return
+    mine = MetricsWriter(path)
     with _CONF_LOCK:
         prev = _WRITER
-        _WRITER = MetricsWriter(path)
+        _WRITER = mine
     try:
         yield
     finally:
         with _CONF_LOCK:
-            _WRITER.close()
-            _WRITER = prev
+            # A configure() call inside the region may have replaced (and
+            # closed) our writer — only close/restore what is still ours.
+            if _WRITER is mine:
+                _WRITER = prev
+        mine.close()

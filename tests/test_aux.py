@@ -89,6 +89,8 @@ class FlakyTechnique(BaseTechnique):
 
     def execute(self, task, devices, tid, override_batch_count=None):
         if task.name.startswith("bad"):
+            # simulate device state cached before the crash
+            task._live_state = ("key", object())
             raise RuntimeError(f"injected failure for {task.name}")
         import numpy as np
 
@@ -150,6 +152,21 @@ class TestFailureIsolation:
         n = len(kinds)
         metrics.event("leak-check")
         assert len(read_events(str(tmp_path / "m.jsonl"))) == n
+        # evicted task's cached device state must be freed (HBM release)
+        assert bad._live_state is None
+
+    def test_scoped_survives_inner_configure(self, tmp_path):
+        """configure() inside a scoped region must not crash the exit path
+        or close the user's replacement writer."""
+        p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        with metrics.scoped(p1):
+            metrics.event("one")
+            metrics.configure(p2)  # replaces (and closes) the scoped writer
+            metrics.event("two")
+        metrics.event("three")  # p2 writer still active after scoped exit
+        metrics.configure(None)
+        assert [e["kind"] for e in read_events(p1)] == ["one"]
+        assert [e["kind"] for e in read_events(p2)] == ["two", "three"]
 
     def test_raise_policy_crashes_batch(self, tmp_path):
         saturn_tpu, good, bad = self._setup(tmp_path)

@@ -1,0 +1,107 @@
+"""Pallas flash attention vs dense reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from saturn_tpu.ops.flash import flash_attention
+
+
+def dense_attention(q, k, v, causal=True):
+    B, H, T, D = q.shape
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def mk_qkv(B=2, H=2, T=128, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+        for _ in range(3)
+    )
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        q, k, v = mk_qkv()
+        out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_uneven_blocks(self):
+        q, k, v = mk_qkv(T=192)
+        out = flash_attention(q, k, v, block_q=64, block_k=32)
+        ref = dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_rejects_indivisible(self):
+        q, k, v = mk_qkv(T=100)
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention(q, k, v, block_q=64, block_k=64)
+
+    def test_bf16(self):
+        q, k, v = (t.astype(jnp.bfloat16) for t in mk_qkv())
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        ref = dense_attention(q, k, v)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_dense(self, causal):
+        q, k, v = mk_qkv(T=128)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+            return jnp.sum(jnp.sin(o))  # nontrivial cotangent
+
+        def loss_dense(q, k, v):
+            return jnp.sum(jnp.sin(dense_attention(q, k, v, causal=causal)))
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                err_msg=f"d{name} mismatch",
+            )
+
+
+class TestFlashModel:
+    def test_model_flash_matches_dense(self):
+        from saturn_tpu.models.gpt2 import build_gpt2
+
+        dense = build_gpt2("test-tiny")
+        flash = build_gpt2("test-tiny", attention="flash")
+        params = dense.init_fn(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 255)
+        ld = dense.apply_fn(params, tokens)
+        lf = flash.apply_fn(params, tokens)
+        np.testing.assert_allclose(np.asarray(ld), np.asarray(lf),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_model_flash_trains(self):
+        from saturn_tpu.models.gpt2 import build_gpt2
+        from tests.test_models import check_trains
+
+        check_trains(build_gpt2("test-tiny", attention="flash"))
+
+    def test_attention_validated(self):
+        from saturn_tpu.models.gpt2 import config_for
+
+        with pytest.raises(ValueError, match="attention"):
+            config_for("test-tiny", attention="fast")
