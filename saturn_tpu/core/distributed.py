@@ -1,0 +1,65 @@
+"""Multi-host entry points: cluster rendezvous and the global topology.
+
+The reference's multi-worker story was per-job NCCL process groups
+rendezvousing over localhost (``FSDP.py:44-50``, ``DDP.py:28-34``) under a
+Ray control plane, and its solver forbade cross-node jobs outright
+(``milp.py:134-137``). The TPU-native story is inverted: **one** JAX
+distributed runtime spans all hosts (each host drives its local slice), and
+after :func:`initialize` every host sees the same global ``jax.devices()``
+list. From there, everything is ordinary saturn_tpu — a slice-aware
+:class:`~saturn_tpu.core.mesh.SliceTopology` over the global device list,
+meshes over contiguous blocks, XLA collectives over ICI within a slice and
+DCN across slices (the sharding layout puts only the ``data`` axis across
+DCN; see ``SliceTopology``).
+
+Single-host runs never need this module.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+log = logging.getLogger("saturn_tpu")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host cluster (idempotent).
+
+    Thin wrapper over ``jax.distributed.initialize``; with no arguments, JAX
+    auto-detects the TPU pod environment (the common case on Cloud TPU VMs).
+    Call once per host, before any other JAX API touches devices.
+    """
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # already initialized — keep this idempotent for notebook reruns
+        if "already" not in str(e).lower():
+            raise
+        log.info("jax.distributed already initialized; continuing")
+    log.info(
+        "multi-host: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def global_topology():
+    """Slice-aware topology over every device in the cluster.
+
+    Blocks of at most one slice stay on ICI; larger (slice-multiple) blocks
+    put their leading mesh axis across DCN.
+    """
+    from saturn_tpu.core.mesh import SliceTopology
+
+    return SliceTopology()  # groups jax.devices() by process_index

@@ -54,23 +54,60 @@ class Block:
 
 
 class SliceTopology:
-    """The pod slice the scheduler allocates from.
+    """The accelerator pool the scheduler allocates from — one pod slice, or
+    several slices joined by DCN (multi-host / multi-slice).
 
     Replaces the reference's ``ray.nodes()`` GPU discovery (``milp.py:53-62``,
     including its hardcoded ``DEBUG=True`` 8-GPUs-per-node stub — we take an
-    explicit device list instead).
+    explicit device list instead). The reference pinned every job to one node
+    (``milp.py:134-137``) because its data plane was single-node NCCL; here
+    the analogous *soft* constraint falls out of buddy allocation: devices
+    are ordered **slice-major**, so with power-of-two slice sizes an aligned
+    block of ≤ one slice never crosses a slice boundary (its collectives ride
+    ICI), and only whole-multiple-of-slice blocks span DCN — at which point
+    the leading (``data``) mesh axis is the one crossing DCN, the standard
+    multi-slice recipe (grad all-reduce over DCN once per step).
+
+    ``slice_size``: devices per ICI domain. Default: inferred by grouping
+    ``device.process_index`` (every host drives its own slice); single-host
+    device sets form one slice.
     """
 
-    def __init__(self, devices: Optional[Sequence[Any]] = None):
+    def __init__(
+        self,
+        devices: Optional[Sequence[Any]] = None,
+        slice_size: Optional[int] = None,
+    ):
         if devices is None:
             import jax
 
             devices = jax.devices()
-        self.devices: List[Any] = list(devices)
+        devices = list(devices)
+        if slice_size is None:
+            groups: dict = {}
+            for d in devices:
+                groups.setdefault(getattr(d, "process_index", 0), []).append(d)
+            sizes = {len(g) for g in groups.values()}
+            if len(groups) > 1 and len(sizes) == 1 and _is_pow2(next(iter(sizes))):
+                slice_size = next(iter(sizes))
+                # slice-major order: sort groups by process index
+                devices = [
+                    d for _, g in sorted(groups.items()) for d in g
+                ]
+            else:
+                slice_size = len(devices)  # one ICI domain
+        self.slice_size = slice_size
+        self.devices: List[Any] = devices
         n = len(self.devices)
         # Usable capacity is the largest power of two <= N so buddy allocation
         # is well-formed even on odd-sized device sets (e.g. CPU test meshes).
         self.capacity = 1 << (n.bit_length() - 1)
+
+    def crosses_dcn(self, block: Block) -> bool:
+        """Does this block span more than one ICI slice?"""
+        return (block.offset // self.slice_size) != (
+            (block.end - 1) // self.slice_size
+        )
 
     def valid_sizes(self, max_size: Optional[int] = None) -> List[int]:
         """All allocatable sub-mesh sizes: powers of two up to capacity."""

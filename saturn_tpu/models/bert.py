@@ -1,0 +1,98 @@
+"""BERT-class encoder family: bidirectional transformer + masked-LM objective.
+
+Second model family alongside GPT-2/GPT-J (the target workload class is a
+"GPT-2/BERT-class sweep", BASELINE.md). Reuses the scanned GPT-2 stack
+(``models/gpt2.py``) with ``causal=False`` — parallelism techniques see the
+identical param-tree structure, so dp/fsdp/tp/pp/offload all work unchanged;
+sequence-parallel techniques correctly report infeasible (their
+boundary-label loss assumes causal next-token training).
+
+Masking is *static-positional* (every ``MASK_STRIDE``-th token): the mask
+derives from position alone, so the jitted train step needs no RNG plumbing
+or dynamic shapes, and the loss and forward agree on exactly which positions
+are masked. This trades BERT's random 15% masking for determinism; the
+compute/communication profile — what the profiler and solver care about —
+is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import optax
+
+from saturn_tpu.core.modelspec import ModelSpec
+from saturn_tpu.models import gpt2
+
+MASK_STRIDE = 7   # ~14% of positions masked, close to BERT's 15%
+MASK_OFFSET = 3
+
+BERT_PRESETS: Dict[str, Dict[str, Any]] = {
+    "bert-test-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, vocab_size=256, seq_len=64,
+    ),
+    "bert-base": dict(d_model=768, n_layers=12, n_heads=12),
+    "bert-large": dict(d_model=1024, n_layers=24, n_heads=16),
+}
+
+# Encoder presets live in the shared preset table so config_for/build_gpt2
+# machinery (validation, overrides) applies unchanged.
+for _name, _kw in BERT_PRESETS.items():
+    gpt2.PRESETS.setdefault(_name, dict(_kw, causal=False))
+
+
+def _mask(T: int):
+    return (jnp.arange(T) % MASK_STRIDE) == MASK_OFFSET
+
+
+def mlm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mean cross-entropy at the masked positions vs the ORIGINAL tokens.
+
+    Pairs with :func:`build_bert`, whose forward replaces the same positions
+    with the [MASK] id — ``tokens`` is the unmasked batch the dataloader
+    serves, exactly like the causal ``pretraining_loss`` contract.
+    """
+    B, T = tokens.shape
+    m = _mask(T)[None, :].astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, tokens)
+    return (ce * m).sum() / (m.sum() * B)
+
+
+def build_bert(name: str = "bert-base", **overrides) -> ModelSpec:
+    """Encoder ModelSpec for ``Task(get_model=...)``; train with :func:`mlm_loss`.
+
+    The top vocab id serves as [MASK] (vocab sizes are padded to a multiple
+    of 128 for MXU tiling, so the top id is never a real token). The [MASK]
+    substitution is applied inside every forward entry point — including the
+    pipeline-stage ``embed`` hint, so pp/offload-streaming train the same
+    objective as dp/fsdp/tp.
+    """
+    if name not in BERT_PRESETS:
+        raise KeyError(f"unknown BERT preset {name!r}; options: {list(BERT_PRESETS)}")
+    spec = gpt2.build_gpt2(name, **overrides)
+    cfg = spec.config
+    mask_id = cfg.vocab_size - 1
+
+    def mask_tokens(tokens):
+        return jnp.where(_mask(tokens.shape[-1])[None, :], mask_id, tokens)
+
+    inner_apply = spec.apply_fn
+
+    def apply_fn(params, tokens):
+        return inner_apply(params, mask_tokens(tokens))
+
+    hints = dict(spec.hints)
+    if "pipeline" in hints:
+        pipe = dict(hints["pipeline"])
+        inner_embed = pipe["embed"]
+        pipe["embed"] = lambda other, tokens: inner_embed(other, mask_tokens(tokens))
+        hints["pipeline"] = pipe
+
+    return ModelSpec(
+        init_fn=spec.init_fn,
+        apply_fn=apply_fn,
+        config=cfg,
+        hints=hints,
+        apply_with_aux_fn=None,
+    )

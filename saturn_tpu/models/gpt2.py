@@ -68,6 +68,10 @@ class GPT2Config:
     # "flash" (fused Pallas kernel, ops/flash.py). Ignored when seq_axis is
     # set (sequence-parallel attention has its own kernels).
     attention: str = "dense"
+    # False = bidirectional (encoder / BERT-class) attention. Sequence-
+    # parallel attention paths assume causal, so seq techniques are only
+    # feasible for causal configs.
+    causal: bool = True
     name: str = "gpt2-small"
 
     def __post_init__(self) -> None:
@@ -212,13 +216,14 @@ class Block(nn.Module):
         elif cfg.attention == "flash":
             from saturn_tpu.ops.flash import flash_attention
 
-            attn = flash_attention(q, k, v, causal=True)
+            attn = flash_attention(q, k, v, causal=cfg.causal)
         else:
             # fp32 softmax accumulation for stability; matmuls stay bf16-in.
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
             scores = scores / math.sqrt(cfg.head_dim)
-            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-            scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
+            if cfg.causal:
+                mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+                scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
@@ -379,7 +384,9 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         "n_layers": cfg.n_layers,
         "moe": {"n_experts": cfg.n_experts} if cfg.moe else None,
         "embed_param_keys": ("wte",) if cfg.rotary else ("wte", "wpe"),
-        "seq_parallel": True,  # factory accepts seq_axis/seq_axis_size
+        # factory accepts seq_axis/seq_axis_size; the sharded attention +
+        # boundary-label loss assume causal next-token training.
+        "seq_parallel": cfg.causal,
         "pipeline": {
             "embed": pipeline_embed,
             "block": pipeline_block,

@@ -6,10 +6,16 @@ two matmuls; this kernel keeps score blocks in VMEM with the online-softmax
 recurrence (Flash-Attention-2 style), so HBM traffic drops from O(T²) to
 O(T·D) and both matmuls feed the MXU back-to-back.
 
+VMEM footprint is O(block · D) per program, independent of T: the key/value
+walk is a **grid dimension** (innermost, sequential on TPU), with k/v tiles
+pipelined HBM→VMEM by Pallas block specs and the softmax state (m, l, acc)
+carried in VMEM scratch across the kv steps — so long-context sequences
+never stage a full (T, D) operand on chip.
+
 Shapes: (B, H, T, D) with T % block == 0. The backward pass is the standard
-two-kernel split — a dQ kernel gridded over query blocks and a dK/dV kernel
-gridded over key blocks — recomputing P = exp(S - lse) from the forward's
-saved logsumexp.
+two-kernel split — a dQ kernel gridded over (query block × kv step) and a
+dK/dV kernel gridded over (kv block × query step) — recomputing
+P = exp(S - lse) from the forward's saved logsumexp.
 
 Used by the model zoo when ``GPT2Config.attention == "flash"``; numerics are
 validated against the dense reference in interpret mode on CPU
@@ -26,6 +32,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -35,75 +42,89 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-# --------------------------------------------------------------------- fwd
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
-                scale, causal, seq_len):
-    iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
-    D = q.shape[-1]
+def _block_mask(iq, jk, block_q, block_k):
+    """(BQ, BK) causal mask for query block iq vs key block jk."""
     q_pos = iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
+    k_pos = jk * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return q_pos >= k_pos
 
-    n_kv = seq_len // block_k
+
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+# --------------------------------------------------------------------- fwd
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, block_q, block_k, scale, causal):
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Blocks fully above the causal diagonal contribute nothing: skip the
+    # matmuls (the k/v fetch is pipelined by the grid either way).
+    needed = True
     if causal:
-        # kv blocks strictly above the diagonal contribute nothing
-        n_kv = jax.lax.div(iq * block_q + block_q + block_k - 1, block_k)
+        needed = jk * block_k <= iq * block_q + block_q - 1
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                              # (BQ, BK)
+    @pl.when(needed)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+        kb = k_ref[0].astype(jnp.float32)                 # (BK, D)
+        vb = v_ref[0].astype(jnp.float32)
+        s = _dot(q, kb, ((1,), (1,)))                     # (BQ, BK)
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
+            s = jnp.where(_block_mask(iq, jk, block_q, block_k), s, NEG_INF)
+        m_prev, l_prev = m_scr[:, 0], l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = corr * l + p.sum(axis=-1)
-        acc_new = corr[:, None] * acc + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, acc_new
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = corr * l_prev + p.sum(axis=-1)
+        acc_scr[:] = corr[:, None] * acc_scr[:] + _dot(p, vb, ((1,), (0,)))
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
-
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    @pl.when(jk == n_kv - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:, 0] + jnp.log(l)
 
 
 def _fwd(q, k, v, *, block_q, block_k, scale, causal):
     BH, T, D = q.shape
-    grid = (BH, T // block_q)
-    kv_spec = pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0))
+    grid = (BH, T // block_q, T // block_k)
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
-            causal=causal, seq_len=T,
+            causal=causal,
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
-            kv_spec,
-            kv_spec,
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             jax.ShapeDtypeStruct((BH, T), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, D), jnp.float32),   # output accumulator
         ],
         interpret=_use_interpret(),
     )(q, k, v)
@@ -111,102 +132,73 @@ def _fwd(q, k, v, *, block_q, block_k, scale, causal):
 
 
 # --------------------------------------------------------------------- bwd
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               block_q, block_k, scale, causal, seq_len):
-    iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    D = q.shape[-1]
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, block_q, block_k, scale, causal):
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    n_kv = pl.num_programs(2)
 
-    n_kv = seq_len // block_k
+    @pl.when(jk == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = True
     if causal:
-        n_kv = jax.lax.div(iq * block_q + block_q + block_k - 1, block_k)
+        needed = jk * block_k <= iq * block_q + block_q - 1
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    @pl.when(needed)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _dot(q, kb, ((1,), (1,)))
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(
-            do, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            s = jnp.where(_block_mask(iq, jk, block_q, block_k), s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = _dot(do, vb, ((1,), (1,)))
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_scr[:] = dq_scr[:] + _dot(ds, kb, ((1,), (0,)))
 
-    dq = jax.lax.fori_loop(0, n_kv, body, jnp.zeros((block_q, D), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(jk == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, block_q, block_k, scale, causal, seq_len):
-    jk = pl.program_id(1)
-    kb = k_ref[0].astype(jnp.float32)                  # (BK, D)
-    vb = v_ref[0].astype(jnp.float32)
-    D = kb.shape[-1]
-    k_pos = jk * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
+                dk_ref, dv_ref, dk_scr, dv_scr, *, block_q, block_k, scale,
+                causal):
+    jk, iq = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
 
-    n_q = seq_len // block_q
-    lo = 0
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = True
     if causal:
-        # q blocks strictly left of this kv block see nothing of it
-        lo = jax.lax.div(jk * block_k, block_q)
+        needed = iq * block_q + block_q - 1 >= jk * block_k
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
-        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    @pl.when(needed)
+    def _accumulate():
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        qb = q_ref[0].astype(jnp.float32) * scale
+        dob = do_ref[0].astype(jnp.float32)
+        s = _dot(qb, kb, ((1,), (1,)))
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                  # (BQ, BK)
-        dv_new = dv + jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            dob, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta[:, None])
-        dk_new = dk + jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk_new, dv_new
+            s = jnp.where(_block_mask(iq, jk, block_q, block_k), s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])                # (BQ, BK)
+        dv_scr[:] = dv_scr[:] + _dot(p, dob, ((0,), (0,)))
+        dp = _dot(dob, vb, ((1,), (1,)))
+        ds = p * (dp - delta_ref[0][:, None])
+        # qb already carries the scale factor; dk needs none extra.
+        dk_scr[:] = dk_scr[:] + _dot(ds, qb, ((0,), (0,)))
 
-    dk0 = jnp.zeros((block_k, D), jnp.float32)
-    dv0 = jnp.zeros((block_k, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk0, dv0))
-    # qb above already carries one factor of scale; dk needs none extra.
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(iq == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd(block_q, block_k, scale, causal, res, do):
@@ -214,49 +206,51 @@ def _bwd(block_q, block_k, scale, causal, res, do):
     BH, T, D = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
-    kv_spec = pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0))
-    row_spec = pl.BlockSpec((1, T), lambda bh, i: (bh, 0))
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, block_q=block_q, block_k=block_k, scale=scale,
-            causal=causal, seq_len=T,
+            causal=causal,
         ),
-        grid=(BH, T // block_q),
+        grid=(BH, T // block_q, T // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
-            kv_spec,
-            kv_spec,
-            pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
 
-    q_full = pl.BlockSpec((1, T, D), lambda bh, j: (bh, 0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, block_q=block_q, block_k=block_k, scale=scale,
-            causal=causal, seq_len=T,
+            causal=causal,
         ),
-        grid=(BH, T // block_k),
+        grid=(BH, T // block_k, T // block_q),
         in_specs=[
-            q_full,
-            pl.BlockSpec((1, block_k, D), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, j: (bh, j, 0)),
-            q_full,
-            row_spec,
-            row_spec,
+            pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i)),
+            pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), k.dtype),
             jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
@@ -298,9 +292,9 @@ def flash_attention(
 ) -> jax.Array:
     """Fused causal attention over (B, H, T, D); differentiable.
 
-    Falls back silently is NOT done here: T must divide by the block sizes
-    (defaults: min(128, T)) or this raises — the model layer picks dense vs
-    flash, this op stays strict.
+    T must divide by the block sizes (defaults: min(128, T)) or this raises —
+    the model config validates the constraint up front
+    (``GPT2Config.__post_init__``); this op stays strict.
     """
     B, H, T, D = q.shape
     bq = block_q or min(128, T)

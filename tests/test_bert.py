@@ -1,0 +1,141 @@
+"""BERT-class encoder family: bidirectionality, MLM objective, executor parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from saturn_tpu.models.bert import (
+    MASK_OFFSET,
+    MASK_STRIDE,
+    build_bert,
+    mlm_loss,
+)
+
+
+@pytest.fixture(scope="module")
+def bert_spec():
+    return build_bert("bert-test-tiny")
+
+
+@pytest.fixture()
+def bert_task(tmp_path):
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+
+    return Task(
+        get_model=lambda **kw: build_bert("bert-test-tiny", **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256, n_tokens=64 * 8 * 8
+        ),
+        loss_fn=mlm_loss,
+        hparams=HParams(lr=1e-3, batch_count=8),
+        save_dir=str(tmp_path / "ckpts"),
+    )
+
+
+class TestBertModel:
+    def test_presets(self):
+        for name in ("bert-base", "bert-large", "bert-test-tiny"):
+            assert build_bert(name).config.causal is False
+        with pytest.raises(KeyError):
+            build_bert("bert-huge")
+
+    def test_forward_shape(self, bert_spec):
+        cfg = bert_spec.config
+        params = bert_spec.init_fn(jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, cfg.seq_len), dtype=jnp.int32)
+        assert bert_spec.apply_fn(params, tokens).shape == (
+            2, cfg.seq_len, cfg.vocab_size,
+        )
+
+    def test_bidirectional(self, bert_spec):
+        """Encoder: a LATER token change must affect EARLIER logits."""
+        params = bert_spec.init_fn(jax.random.PRNGKey(0))
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 64), 0, 250)
+        t2 = t1.at[0, 50].set((t1[0, 50] + 1) % 250)
+        l1 = bert_spec.apply_fn(params, t1)
+        l2 = bert_spec.apply_fn(params, t2)
+        assert not np.allclose(np.asarray(l1[0, :10]), np.asarray(l2[0, :10]),
+                               atol=1e-5)
+
+    def test_masking_applied(self, bert_spec):
+        """Changing a token at a MASKED position must not change the logits —
+        the forward must see [MASK] there, not the token."""
+        params = bert_spec.init_fn(jax.random.PRNGKey(0))
+        pos = MASK_OFFSET  # a masked position
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 64), 0, 250)
+        t2 = t1.at[0, pos].set((t1[0, pos] + 1) % 250)
+        l1 = bert_spec.apply_fn(params, t1)
+        l2 = bert_spec.apply_fn(params, t2)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-6)
+
+    def test_mlm_loss_only_masked_positions(self):
+        B, T, V = 2, 14, 11
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(B, T, V)), jnp.float32)
+        tokens = jnp.asarray(rng.integers(0, V, size=(B, T)), jnp.int32)
+        # perturb one class at a NON-masked position: loss must not change
+        l0 = float(mlm_loss(logits, tokens))
+        logits2 = logits.at[:, MASK_OFFSET + 1, 0].add(3.0)
+        assert float(mlm_loss(logits2, tokens)) == pytest.approx(l0)
+        # perturb one class at a masked position: loss changes
+        logits3 = logits.at[:, MASK_OFFSET, 0].add(3.0)
+        assert float(mlm_loss(logits3, tokens)) != pytest.approx(l0)
+
+    def test_trains(self, bert_spec):
+        import optax
+
+        params = bert_spec.init_fn(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0, 250)
+        tx = optax.adam(1e-3)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt):
+            loss, g = jax.value_and_grad(
+                lambda p: mlm_loss(bert_spec.apply_fn(p, tokens), tokens)
+            )(params)
+            up, opt = tx.update(g, opt, params)
+            return optax.apply_updates(params, up), opt, loss
+
+        losses = []
+        for _ in range(5):
+            params, opt, loss = step(params, opt)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
+class TestBertExecutors:
+    def test_dp_and_fsdp(self, bert_task, devices8):
+        from saturn_tpu.parallel.dp import DataParallel
+        from saturn_tpu.parallel.fsdp import FSDP
+        from tests.test_executors import run_search_and_execute
+
+        run_search_and_execute(DataParallel(), bert_task, devices8[:2])
+        bert_task.clear_ckpt()
+        run_search_and_execute(FSDP(), bert_task, devices8[:4])
+
+    def test_pp_matches_dp_objective(self, bert_task, devices8):
+        """Pipeline embed hint must apply [MASK] too — same loss as dp."""
+        from saturn_tpu.parallel.dp import DataParallel
+        from saturn_tpu.parallel.pp import Pipeline
+
+        dp, pp = DataParallel(), Pipeline()
+        b_dp = dp.build(bert_task, devices8[:2], {"remat": False})
+        b_pp = pp.build(
+            bert_task, devices8[:2], {"stages": 2, "microbatches": 2, "remat": False}
+        )
+        s_dp, s_pp = b_dp.init(), b_pp.init()
+        batch = bert_task.batch_at(0)
+        _, l_dp = b_dp.step(s_dp, jax.device_put(batch, b_dp.batch_sharding))
+        _, l_pp = b_pp.step(s_pp, jax.device_put(batch, b_pp.batch_sharding))
+        np.testing.assert_allclose(float(l_dp), float(l_pp), rtol=2e-2)
+
+    def test_seq_parallel_infeasible(self, bert_task, devices8):
+        """Encoder models must be infeasible for causal seq techniques."""
+        from saturn_tpu.parallel.ring import RingSequenceParallel
+        from saturn_tpu.parallel.ulysses import UlyssesSequenceParallel
+
+        assert RingSequenceParallel().candidate_configs(bert_task, 8) == []
+        assert UlyssesSequenceParallel().candidate_configs(bert_task, 8) == []

@@ -79,6 +79,64 @@ class TestTopology:
             make_submesh(devices8, ("data", "model"), (3, 2))
 
 
+class FakeDev:
+    """Stand-in device with the attrs SliceTopology groups by."""
+
+    def __init__(self, id, process_index):
+        self.id = id
+        self.process_index = process_index
+
+    def __repr__(self):
+        return f"d{self.id}@p{self.process_index}"
+
+
+class TestMultiSliceTopology:
+    def mk(self, n_slices=2, per_slice=8, interleave=False):
+        devs = [
+            FakeDev(s * per_slice + i, process_index=s)
+            for s in range(n_slices)
+            for i in range(per_slice)
+        ]
+        if interleave:
+            devs = devs[::2] + devs[1::2]  # scrambled arrival order
+        return SliceTopology(devices=devs)
+
+    def test_slice_detection_and_ordering(self):
+        topo = self.mk(interleave=True)
+        assert topo.slice_size == 8 and topo.capacity == 16
+        # re-sorted slice-major: first 8 devices all process 0
+        assert [d.process_index for d in topo.devices] == [0] * 8 + [1] * 8
+
+    def test_ici_blocks_never_cross_dcn(self):
+        topo = self.mk()
+        for size in (1, 2, 4, 8):
+            for blk in topo.blocks(size):
+                assert not topo.crosses_dcn(blk), (size, blk)
+        assert topo.crosses_dcn(topo.blocks(16)[0])
+
+    def test_data_axis_spans_dcn(self):
+        """For a DCN-crossing block, the leading (data) mesh axis is the one
+        that crosses slices — the multi-slice grad-allreduce recipe."""
+        topo = self.mk()
+        blk = topo.blocks(16)[0]
+        mesh = make_submesh(topo.block_devices(blk), ("data", "model"), (2, 8))
+        import numpy as np
+
+        procs = np.vectorize(lambda d: d.process_index)(mesh.devices)
+        assert (procs[0] == 0).all() and (procs[1] == 1).all()
+
+    def test_single_host_is_one_domain(self):
+        topo = SliceTopology(devices=[FakeDev(i, 0) for i in range(8)])
+        assert topo.slice_size == 8
+        assert not topo.crosses_dcn(topo.blocks(8)[0])
+
+    def test_non_pow2_groups_fall_back(self):
+        devs = [FakeDev(i, i % 3) for i in range(9)]  # 3 groups of 3
+        topo = SliceTopology(devices=devs)
+        assert topo.slice_size == 9  # one domain; buddy alloc still valid
+        assert topo.capacity == 8
+
+
 class TestLibrary:
     def test_register_type_check(self):
         with pytest.raises(TypeError):
