@@ -1,24 +1,109 @@
 """Headline benchmark: flagship training throughput on real hardware.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Metric: GPT-2-small causal-LM training throughput (tokens/sec) at batch 8 ×
 seq 512 — driver config #1 ("GPT-2-small on WikiText-103, single job, 1
 device", BASELINE.md). The reference publishes no in-tree numbers
 (SURVEY.md §6), so the baseline is self-measured: the first recorded run's
-value is stored in ``bench_baseline.json`` and later runs report
+value per platform is stored in ``bench_baseline.json`` and later runs report
 ``vs_baseline = value / baseline`` (>1 is faster).
+
+Round-1 hardening: the TPU backend can fail to init transiently
+(``UNAVAILABLE`` through the tunnel — BENCH_r01.json rc=1). The backend is
+now probed in a bounded-time subprocess with retries before the in-process
+run; on persistent failure the benchmark falls back to CPU so a parsed
+number always exists, with the degradation recorded in the JSON line.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import time
 import timeit
+
+# bf16 peak TFLOP/s per chip, by device_kind substring (public specs).
+_PEAK_TFLOPS = {
+    "v2": 45.0,
+    "v3": 123.0,
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6": 918.0,
+    "cpu": 0.0,  # no meaningful MFU on host
+}
+
+
+def _probe_backend(timeout_s: float = 120.0, retries: int = 2, delay_s: float = 10.0):
+    """Probe default-backend availability in a subprocess (bounded time).
+
+    Returns the platform string on success, None after all retries fail.
+    A subprocess keeps a wedged TPU tunnel from hanging or poisoning the
+    parent's backend cache.
+    """
+    code = "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform)"
+    for attempt in range(retries + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            for line in r.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    return line.split("=", 1)[1]
+            diag = (r.stderr or r.stdout).strip().splitlines()
+            print(
+                f"bench: backend probe attempt {attempt + 1} failed "
+                f"(rc={r.returncode}): {diag[-1] if diag else '<no output>'}",
+                file=sys.stderr,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"bench: backend probe attempt {attempt + 1} timed out "
+                f"after {timeout_s}s",
+                file=sys.stderr,
+            )
+        if attempt < retries:
+            time.sleep(delay_s)
+    return None
+
+
+def _flops_per_step(cfg, batch_size: int, seq_len: int, n_params: int) -> float:
+    """Training FLOPs per step: 6N per token + attention score/value terms
+    (12·L·S·D per token), the standard MFU accounting."""
+    tokens = batch_size * seq_len
+    return tokens * (6.0 * n_params + 12.0 * cfg.n_layers * seq_len * cfg.d_model)
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", device.platform).lower()
+    for key, peak in _PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    return 0.0
 
 
 def main() -> None:
+    platform = _probe_backend()
+    degraded = False
+    if platform is None:
+        # Persistent backend failure: fall back to CPU so the round still
+        # produces a measured number; record the degradation.
+        degraded = True
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        print("bench: TPU backend unavailable after retries; CPU fallback", file=sys.stderr)
+
     import jax
+
+    if degraded:
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     import optax
 
@@ -51,6 +136,7 @@ def main() -> None:
 
     step = jax.jit(train_step, donate_argnums=(0,))
     state = jax.jit(init_state)()
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(state["params"]))
     batches = [jnp.asarray(ds.batch(i)) for i in range(8)]
 
     # compile + warmup (excluded from timing; SURVEY.md §7 "honest profiling").
@@ -69,15 +155,23 @@ def main() -> None:
 
     tokens_per_sec = batch_size * seq_len / dt
 
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
-    platform = jax.devices()[0].platform
-    key = f"gpt2s_train_tokens_per_sec_{platform}"
+    dev = jax.devices()[0]
+    peak = _peak_tflops(dev)
+    mfu = None
+    if peak > 0:
+        achieved = _flops_per_step(spec.config, batch_size, seq_len, n_params) / dt
+        mfu = achieved / (peak * 1e12)
+
+    base_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json"
+    )
+    key = f"gpt2s_train_tokens_per_sec_{dev.platform}"
     baseline = None
     if os.path.exists(base_path):
         with open(base_path) as f:
             baseline = json.load(f).get(key)
     if baseline is None:
-        baseline = tokens_per_sec  # first run defines the baseline
+        baseline = tokens_per_sec  # first run on this platform defines the baseline
         try:
             data = {}
             if os.path.exists(base_path):
@@ -89,16 +183,18 @@ def main() -> None:
         except OSError:
             pass
 
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2s_train_tokens_per_sec",
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(tokens_per_sec / baseline, 4),
-            }
-        )
-    )
+    out = {
+        "metric": "gpt2s_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / baseline, 4),
+        "platform": dev.platform,
+    }
+    if mfu is not None:
+        out["mfu"] = round(mfu, 4)
+    if degraded:
+        out["degraded"] = "tpu_unavailable_cpu_fallback"
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

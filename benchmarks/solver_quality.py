@@ -1,0 +1,118 @@
+"""Solver quality/scale harness (VERDICT r1 item 5).
+
+Compares the native C++ scheduler (``native/spase.cpp``) against the exact
+HiGHS MILP on random instances at MILP-tractable sizes (gap %), and
+stress-tests the native path at the north-star scale (16-32 tasks, capacity
+64 — the v4-64 flagship config, BASELINE.md) where the exact formulation's
+O(N²·devices) big-M rows are far beyond any MILP budget.
+
+Run: ``python benchmarks/solver_quality.py [--quick]``. Prints a markdown
+table; paste into BASELINE.md. Hardware-free (solver consumes only numbers,
+reference ``milp.py:77-81``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.solver import native_sched
+from saturn_tpu.solver.milp import greedy_plan, solve
+
+
+class _Dev:
+    pass
+
+
+class _Task:
+    def __init__(self, name, runtimes):
+        self.name = name
+        self.strategies = {
+            g: Strategy(object(), g, {}, rt, 0.1) for g, rt in runtimes.items()
+        }
+
+    def feasible_strategies(self):
+        return self.strategies
+
+
+def rand_tasks(n, cap, rng):
+    """Random HPO-batch-like instances: per-task base runtime 20-200s,
+    sublinear scaling across power-of-two sizes (efficiency 0.6-0.95)."""
+    sizes = [s for s in (1, 2, 4, 8, 16, 32, 64) if s <= cap]
+    tasks = []
+    for i in range(n):
+        base = float(rng.uniform(20, 200))
+        rts = {s: base / (s ** float(rng.uniform(0.6, 0.95))) for s in sizes}
+        tasks.append(_Task(f"t{i}", rts))
+    return tasks
+
+
+def topo(cap):
+    return SliceTopology([_Dev() for _ in range(cap)])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="fewer seeds, shorter limits")
+    args = ap.parse_args()
+    seeds = range(3) if args.quick else range(5)
+    exact_limit = 30.0 if args.quick else 120.0
+
+    print("## native scheduler vs exact MILP (capacity 8)\n")
+    print("| n tasks | exact mk (mean) | native mk (mean) | gap mean | gap max | exact s | native s |")
+    print("|---|---|---|---|---|---|---|")
+    for n in (6, 8, 10, 12):
+        gaps, e_mks, n_mks, e_ts, n_ts = [], [], [], [], []
+        for seed in seeds:
+            rng = np.random.default_rng(1000 * n + seed)
+            tasks = rand_tasks(n, 8, rng)
+            t0 = time.perf_counter()
+            ep = solve(tasks, topo(8), time_limit=exact_limit, ordering_slack=0.0)
+            e_ts.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            np_ = native_sched.solve_native(
+                tasks, topo(8), time_limit=2.0, ordering_slack=0.0
+            )
+            n_ts.append(time.perf_counter() - t0)
+            gaps.append(np_.makespan / ep.makespan - 1.0)
+            e_mks.append(ep.makespan)
+            n_mks.append(np_.makespan)
+        print(
+            f"| {n} | {np.mean(e_mks):.1f} | {np.mean(n_mks):.1f} "
+            f"| {100*np.mean(gaps):+.1f}% | {100*np.max(gaps):+.1f}% "
+            f"| {np.mean(e_ts):.1f} | {np.mean(n_ts):.1f} |"
+        )
+
+    print("\n## native scheduler at north-star scale (capacity 64)\n")
+    print("| n tasks | greedy mk | native mk (1s) | native mk (5s) | vs greedy | native 5s wall |")
+    print("|---|---|---|---|---|---|")
+    for n in (16, 24, 32):
+        g_mks, n1_mks, n5_mks, n5_ts = [], [], [], []
+        for seed in seeds:
+            rng = np.random.default_rng(2000 * n + seed)
+            tasks = rand_tasks(n, 64, rng)
+            gp = greedy_plan(tasks, topo(64))
+            g_mks.append(gp.makespan)
+            p1 = native_sched.solve_native(
+                tasks, topo(64), time_limit=1.0, ordering_slack=0.0
+            )
+            n1_mks.append(p1.makespan)
+            t0 = time.perf_counter()
+            p5 = native_sched.solve_native(
+                tasks, topo(64), time_limit=5.0, ordering_slack=0.0
+            )
+            n5_ts.append(time.perf_counter() - t0)
+            n5_mks.append(p5.makespan)
+        print(
+            f"| {n} | {np.mean(g_mks):.1f} | {np.mean(n1_mks):.1f} "
+            f"| {np.mean(n5_mks):.1f} | {100*(np.mean(n5_mks)/np.mean(g_mks)-1):+.1f}% "
+            f"| {np.mean(n5_ts):.1f}s |"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,10 @@ import argparse
 import os
 import time
 
+_BUNDLED_CORPUS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "data", "corpus.txt"
+)
+
 
 def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
@@ -42,8 +46,12 @@ def parse_args():
                    help="scheduling interval seconds (reference default 1000)")
     p.add_argument("--techniques", nargs="+", default=None,
                    help="library names to profile (default: all registered)")
-    p.add_argument("--corpus", default=None,
-                   help="local text file to byte-tokenize (default: synthetic)")
+    p.add_argument("--corpus", default=_BUNDLED_CORPUS,
+                   help="local text file to tokenize; 'synthetic' for the "
+                        "deterministic Zipf stream (default: the bundled "
+                        "examples/data/corpus.txt)")
+    p.add_argument("--tokenizer", choices=["word", "byte"], default="word",
+                   help="corpus tokenizer (native word vocab, or raw bytes)")
     p.add_argument("--save-dir", default="saturn_sweep_ckpts")
     p.add_argument("--platform", choices=["default", "cpu"], default="default",
                    help="cpu = 8 virtual XLA host devices (no TPU needed)")
@@ -75,6 +83,10 @@ def main():
 
     ctx = args.context_length or config_for(args.preset).seq_len
     vocab = config_for(args.preset).vocab_size
+    corpus = None if args.corpus in ("synthetic", "none") else args.corpus
+    if corpus and not os.path.exists(corpus):
+        raise SystemExit(f"corpus file not found: {corpus}")
+    print(f"corpus: {corpus or 'synthetic'} (tokenizer={args.tokenizer})")
 
     # 2) one task per batch size (reference ``WikiText103.py:62-71``).
     base_tasks = []
@@ -84,7 +96,7 @@ def main():
             get_dataloader=lambda bs=bs: make_lm_dataset(
                 context_length=ctx, batch_size=bs, vocab_size=vocab,
                 n_tokens=ctx * bs * max(args.batch_count, 16),
-                corpus_path=args.corpus,
+                corpus_path=corpus, tokenizer=args.tokenizer,
             ),
             loss_fn=pretraining_loss,
             hparams=HParams(lr=args.lrs[0], batch_count=args.batch_count),

@@ -36,17 +36,17 @@ def initialize(
     """
     import jax
 
-    try:
+    already = getattr(jax.distributed, "is_initialized", lambda: False)()
+    if already:
+        # idempotent for notebook reruns; a second initialize would raise
+        # "must be called before any JAX calls"
+        log.info("jax.distributed already initialized; continuing")
+    else:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError as e:
-        # already initialized — keep this idempotent for notebook reruns
-        if "already" not in str(e).lower():
-            raise
-        log.info("jax.distributed already initialized; continuing")
     log.info(
         "multi-host: process %d/%d, %d local / %d global devices",
         jax.process_index(), jax.process_count(),

@@ -17,10 +17,13 @@ which is exactly the property the MILP needs for its non-overlap constraints.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+log = logging.getLogger("saturn_tpu")
 
 
 def _is_pow2(n: int) -> bool:
@@ -102,6 +105,13 @@ class SliceTopology:
         # Usable capacity is the largest power of two <= N so buddy allocation
         # is well-formed even on odd-sized device sets (e.g. CPU test meshes).
         self.capacity = 1 << (n.bit_length() - 1)
+        if self.capacity != n:
+            log.warning(
+                "SliceTopology: %d of %d devices stranded (buddy allocation "
+                "uses the largest power-of-two capacity, %d); devices "
+                "[%d:%d] will never be scheduled",
+                n - self.capacity, n, self.capacity, self.capacity, n,
+            )
 
     def crosses_dcn(self, block: Block) -> bool:
         """Does this block span more than one ICI slice?"""

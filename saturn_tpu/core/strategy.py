@@ -59,3 +59,9 @@ class Strategy:
         """Reference treats params=None as an un-runnable strategy
         (``PerformanceEvaluator.py:96-99,110``)."""
         return self.params is not None and self.executor is not None
+
+    @property
+    def technique(self) -> Optional[Techniques]:
+        """Which built-in technique family this strategy uses (None for
+        user-defined plugins) — plan introspection, e.g. metrics/logs."""
+        return getattr(self.executor, "technique", None)

@@ -32,6 +32,12 @@ class BaseTechnique(abc.ABC):
     #: Optional friendly name used when registering into the library.
     name: str = "base"
 
+    #: Which built-in technique family this is (``Techniques`` enum member),
+    #: None for user-defined plugins. Consumed by ``library.retrieve`` (enum
+    #: lookup) and ``Strategy.technique`` (plan introspection) — the reference
+    #: declared its enum but nothing ever read it (``Strategy.py:25-34``).
+    technique = None  # type: ignore[assignment]  # Optional[Techniques]
+
     @abc.abstractmethod
     def execute(
         self,

@@ -170,6 +170,7 @@ def make_lm_dataset(
     corpus_path: Optional[str] = None,
     seed: int = 0,
     tokenizer: str = "byte",
+    reserved_ids: int = 0,
 ) -> TokenDataset:
     """Dataloader factory for ``Task(get_dataloader=...)``.
 
@@ -177,18 +178,34 @@ def make_lm_dataset(
     raw bytes; vocab must be >= 256) or ``tokenizer="word"`` (native
     frequency-ranked word vocab capped at ``vocab_size``) — else a synthetic
     stream of ``n_tokens`` tokens (default: enough for 64 batches).
+
+    ``reserved_ids`` keeps the top that-many ids of the model's vocab out of
+    the data on every path, so they can serve as special tokens. MLM tasks
+    MUST pass ``reserved_ids=1`` to reserve the [MASK] id
+    (``models/bert.py``): data ids stay in ``[0, vocab_size - reserved_ids)``
+    (synthetic generation and the word vocab are capped; the byte path
+    requires ``vocab_size - reserved_ids >= 256``).
     """
+    if reserved_ids < 0 or reserved_ids >= vocab_size:
+        raise ValueError(f"reserved_ids must be in [0, vocab_size), got {reserved_ids}")
+    data_vocab = vocab_size - reserved_ids
     if corpus_path and os.path.exists(corpus_path):
         if tokenizer == "word":
-            # vocab is *capped* at vocab_size (rare words -> <unk>), so the
-            # id range always fits the model's embedding table.
-            tokens, _ = word_tokenize_file(corpus_path, max_vocab=vocab_size)
+            # vocab is *capped* (rare words -> <unk>), so the id range always
+            # fits the model's embedding table minus any reserved ids.
+            tokens, _ = word_tokenize_file(corpus_path, max_vocab=data_vocab)
         elif tokenizer == "byte":
+            if data_vocab < 256:
+                raise ValueError(
+                    f"byte tokenizer emits ids up to 255 but only "
+                    f"{data_vocab} unreserved ids exist "
+                    f"(vocab_size={vocab_size}, reserved_ids={reserved_ids})"
+                )
             tokens = byte_tokenize_file(corpus_path)
         else:
             raise ValueError(f"unknown tokenizer {tokenizer!r} (byte|word)")
     else:
         if n_tokens is None:
             n_tokens = context_length * batch_size * 64
-        tokens = synthetic_tokens(n_tokens, vocab_size, seed=seed)
+        tokens = synthetic_tokens(n_tokens, data_vocab, seed=seed)
     return TokenDataset(tokens, context_length=context_length, batch_size=batch_size)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Type, Union
 
+from saturn_tpu.core.strategy import Techniques
 from saturn_tpu.core.technique import BaseTechnique
 
 _REGISTRY: Dict[str, Type[BaseTechnique]] = {}
@@ -57,18 +58,18 @@ def deregister(name: str) -> None:
 
 
 def retrieve(
-    names: Union[None, str, List[str]] = None,
+    names: Union[None, str, "Techniques", List] = None,
 ) -> Union[Type[BaseTechnique], List[Type[BaseTechnique]]]:
     """Fetch one / several / all registered techniques (``library.py:52-73``).
 
-    ``None`` returns all (insertion order); a string returns one class; a list
-    returns a list of classes. Falls back to the dill store for names not in
-    the in-process registry.
+    ``None`` returns all (insertion order); a string or a ``Techniques`` enum
+    member returns one class; a list returns a list of classes. Falls back to
+    the dill store for names not in the in-process registry.
     """
     if names is None:
         _load_persisted_missing()
         return list(_REGISTRY.values())
-    if isinstance(names, str):
+    if isinstance(names, (str, Techniques)):
         return _retrieve_one(names)
     return [_retrieve_one(n) for n in names]
 
@@ -78,7 +79,19 @@ def registered_names() -> List[str]:
     return list(_REGISTRY.keys())
 
 
-def _retrieve_one(name: str) -> Type[BaseTechnique]:
+def _retrieve_one(name) -> Type[BaseTechnique]:
+    if isinstance(name, Techniques):
+        _load_persisted_missing()
+        for cls in _REGISTRY.values():
+            # own attribute only: a user subclass of a builtin that doesn't
+            # explicitly claim the enum member must not shadow the builtin
+            # (registration order would otherwise decide which one wins)
+            if cls.__dict__.get("technique") is name:
+                return cls
+        raise KeyError(
+            f"no registered technique implements {name!r}; "
+            "call register_default_library() first"
+        )
     if name in _REGISTRY:
         return _REGISTRY[name]
     d = _persist_dir()

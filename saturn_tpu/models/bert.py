@@ -62,11 +62,15 @@ def mlm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
 def build_bert(name: str = "bert-base", **overrides) -> ModelSpec:
     """Encoder ModelSpec for ``Task(get_model=...)``; train with :func:`mlm_loss`.
 
-    The top vocab id serves as [MASK] (vocab sizes are padded to a multiple
-    of 128 for MXU tiling, so the top id is never a real token). The [MASK]
-    substitution is applied inside every forward entry point — including the
-    pipeline-stage ``embed`` hint, so pp/offload-streaming train the same
-    objective as dp/fsdp/tp.
+    The top vocab id serves as [MASK]. That id must never occur in the data —
+    otherwise unmasked occurrences are indistinguishable from [MASK] and
+    masked positions whose label is the top id leak. The data pipeline
+    enforces this: pair BERT tasks with ``make_lm_dataset(...,
+    reserved_ids=1)``, which keeps ids in ``[0, vocab_size - 1)`` on every
+    path (synthetic generation, word vocab cap, byte-tokenizer validation).
+    The [MASK] substitution is applied inside every forward entry point —
+    including the pipeline-stage ``embed`` hint, so pp/offload-streaming
+    train the same objective as dp/fsdp/tp.
     """
     if name not in BERT_PRESETS:
         raise KeyError(f"unknown BERT preset {name!r}; options: {list(BERT_PRESETS)}")

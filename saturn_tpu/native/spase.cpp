@@ -92,12 +92,20 @@ extern "C" {
 
 // Inputs are flattened: task t's options live at indices
 // [opt_starts[t], opt_starts[t] + opt_counts[t]) of the *_flat arrays.
+// warm_opt (nullable) warm-starts the search from a previous plan: warm_opt[t]
+// is the option index to pin task t to in a second constructor pass (-1 = no
+// pin); the local search then starts from whichever constructor won. This is
+// the native analog of the reference's Gurobi warmStart seeding
+// (saturn/solver/milp.py:103-104,151-155,323).
 // Returns 0 on success, nonzero on malformed input.
-int spase_solve(int n_tasks, const int* opt_counts, const int* opt_offset_flat,
+// (v2: the warm_opt parameter was inserted in round 2 — the symbol is
+// versioned so a stale prebuilt .so fails symbol lookup and the caller
+// falls back gracefully instead of writing through a misplaced pointer.)
+int spase_solve_v2(int n_tasks, const int* opt_counts, const int* opt_offset_flat,
                 const int* opt_size_flat, const double* opt_runtime_flat,
                 int capacity, double time_limit_s, double ordering_slack,
-                uint64_t seed, int* chosen_out, double* start_out,
-                double* makespan_out) {
+                uint64_t seed, const int* warm_opt, int* chosen_out,
+                double* start_out, double* makespan_out) {
   if (n_tasks <= 0 || capacity <= 0) return 1;
 
   Instance inst;
@@ -135,6 +143,31 @@ int spase_solve(int n_tasks, const int* opt_counts, const int* opt_offset_flat,
   std::vector<double> starts, best_starts;
   std::vector<int> forced(n_tasks, -1);
   double best = evaluate(inst, order, forced, best_chosen, best_starts);
+
+  // Warm constructor: pin each task to its previous plan's option and
+  // re-evaluate; adopt if it beats (or ties) the LPT constructor so the
+  // local search walks out from the incumbent schedule.
+  if (warm_opt != nullptr) {
+    std::vector<int> wforced(n_tasks, -1);
+    bool any = false;
+    for (int t = 0; t < n_tasks; ++t) {
+      if (warm_opt[t] >= 0 && warm_opt[t] < static_cast<int>(inst.opts[t].size())) {
+        wforced[t] = warm_opt[t];
+        any = true;
+      }
+    }
+    if (any) {
+      std::vector<int> wchosen;
+      std::vector<double> wstarts;
+      const double wm = evaluate(inst, order, wforced, wchosen, wstarts);
+      if (wm <= best) {
+        best = wm;
+        best_chosen = wchosen;
+        best_starts = wstarts;
+        forced = wforced;
+      }
+    }
+  }
 
   // Local search: random order swap / reinsertion / option-pinning moves,
   // deterministic seed. Pinning a task's option (forced) is what escapes the

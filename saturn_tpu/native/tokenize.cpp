@@ -38,18 +38,34 @@ bool read_file(const char* path, std::string& out) {
   return got == static_cast<size_t>(n);
 }
 
+// ASCII-only classifiers: the std::ctype functions are locale-dependent (a
+// non-C LC_CTYPE classifies bytes >= 0x80 as alnum), which would diverge
+// from the Python fallback's ASCII regex and poison the .npz cache. These
+// match `[a-z0-9]` / `\s` after ASCII lowercasing exactly, per byte.
+inline bool ascii_alnum_lower(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9');
+}
+inline unsigned char ascii_lower(unsigned char c) {
+  return (c >= 'A' && c <= 'Z') ? static_cast<unsigned char>(c + 32) : c;
+}
+inline bool ascii_space(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+         c == '\f';
+}
+
 // Lowercased word (alnum run) / single punctuation-char tokens.
 void split_tokens(const std::string& text, std::vector<std::string>& toks) {
   std::string cur;
-  for (unsigned char c : text) {
-    if (std::isalnum(c)) {
-      cur.push_back(static_cast<char>(std::tolower(c)));
+  for (unsigned char raw : text) {
+    const unsigned char c = ascii_lower(raw);
+    if (ascii_alnum_lower(c)) {
+      cur.push_back(static_cast<char>(c));
     } else {
       if (!cur.empty()) {
         toks.push_back(cur);
         cur.clear();
       }
-      if (!std::isspace(c)) toks.emplace_back(1, static_cast<char>(c));
+      if (!ascii_space(c)) toks.emplace_back(1, static_cast<char>(c));
     }
   }
   if (!cur.empty()) toks.push_back(cur);

@@ -281,6 +281,29 @@ def _flash_bh_bwd(block_q, block_k, causal, res, do):
 _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 
 
+def flash_supported(cfg=None) -> bool:
+    """Can the Pallas kernel lower (not interpret) for this model config?
+
+    Real lowering needs the TPU backend; interpret mode exists only for
+    numerics tests. With a config, also checks the kernel's shape contract
+    (seq divisible by the default block) and that attention is single-program
+    (sequence-parallel configs have their own kernels). Used by the executors'
+    autotune grids so the trial runner profiles flash-vs-dense per task and
+    the solver selects from measurements (VERDICT r1 items 2-3).
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False
+    if cfg is not None:
+        T = getattr(cfg, "seq_len", None)
+        if T is not None and T % min(128, T) != 0:
+            return False
+        if getattr(cfg, "seq_axis", None) is not None:
+            return False
+    return True
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

@@ -13,10 +13,12 @@ from typing import Any, Dict, List, Tuple
 
 from saturn_tpu.parallel import sharding as shr
 from saturn_tpu.parallel.spmd_base import SPMDTechnique
+from saturn_tpu.core.strategy import Techniques
 
 
 class DataParallel(SPMDTechnique):
     name = "dp"
+    technique = Techniques.DP
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         return ("data",), (n_devices,)
@@ -26,5 +28,8 @@ class DataParallel(SPMDTechnique):
 
     def candidate_configs(self, task, n_devices) -> List[Dict[str, Any]]:
         # remat off first (faster when it fits), on as fallback — same
-        # best-guess-first grid ordering idea as ``FSDP.py:72-78``.
-        return [{"remat": False}, {"remat": True}]
+        # best-guess-first grid ordering idea as ``FSDP.py:72-78``; crossed
+        # with flash attention on TPU so the solver picks from measurement.
+        return self._with_attention_variants(
+            task, [{"remat": False}, {"remat": True}]
+        )

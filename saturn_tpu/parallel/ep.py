@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from saturn_tpu.parallel import sharding as shr
 from saturn_tpu.parallel.spmd_base import SPMDTechnique
+from saturn_tpu.core.strategy import Techniques
 
 _EXPERT_PARAM = re.compile(r"(^|/)(we_in|we_out|be_in|be_out)$")
 
@@ -52,6 +53,7 @@ def expert_rules(axis: str, n_experts: int):
 
 class ExpertParallel(SPMDTechnique):
     name = "ep"
+    technique = Techniques.EXPERT
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         ep = config.get("ep", min(n_devices, 2))

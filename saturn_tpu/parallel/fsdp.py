@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from saturn_tpu.parallel import sharding as shr
 from saturn_tpu.parallel.spmd_base import SPMDTechnique
+from saturn_tpu.core.strategy import Techniques
 
 
 def host_offload_supported() -> bool:
@@ -36,6 +37,7 @@ def host_offload_supported() -> bool:
 
 class FSDP(SPMDTechnique):
     name = "fsdp"
+    technique = Techniques.FSDP
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         return ("data",), (n_devices,)
@@ -56,4 +58,4 @@ class FSDP(SPMDTechnique):
                 {"remat": True, "offload": True},
                 {"remat": False, "offload": True},
             ]
-        return grid
+        return self._with_attention_variants(task, grid)

@@ -42,6 +42,7 @@ from saturn_tpu.ops.pipeline import pipeline_hints
 from saturn_tpu.parallel import sharding as shr
 from saturn_tpu.parallel.fsdp import host_offload_supported
 from saturn_tpu.parallel.spmd_base import SPMDTechnique
+from saturn_tpu.core.strategy import Techniques
 
 
 def _to_device(tree):
@@ -50,6 +51,7 @@ def _to_device(tree):
 
 class HostOffload(SPMDTechnique):
     name = "offload"
+    technique = Techniques.OFFLOAD
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         return ("data",), (n_devices,)
@@ -114,6 +116,7 @@ class HostOffload(SPMDTechnique):
 
         # Streaming mode: per-layer fetch inside a scan over the stacked
         # block params (requires the model's pipeline decomposition hints).
+        self._require_no_aux(spec)  # streaming forward would drop an aux loss
         hints = pipeline_hints(spec)
         bkey = spec.hints.get("block_param_key", "blocks")
         embed_fn, block_fn, head_fn = hints["embed"], hints["block"], hints["head"]

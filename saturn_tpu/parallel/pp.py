@@ -20,10 +20,12 @@ from jax.sharding import PartitionSpec as P
 
 from saturn_tpu.ops.pipeline import pipeline_hints, pipeline_loss_and_grads
 from saturn_tpu.parallel.spmd_base import SPMDTechnique
+from saturn_tpu.core.strategy import Techniques
 
 
 class Pipeline(SPMDTechnique):
     name = "pp"
+    technique = Techniques.PIPELINE
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         s = config.get("stages", 2)
@@ -68,6 +70,7 @@ class Pipeline(SPMDTechnique):
         return grid
 
     def make_step_fns(self, spec, task, config, mesh, ds):
+        self._require_no_aux(spec)  # staged forward would drop an aux loss
         s = config.get("stages", 2)
         m = config.get("microbatches", 2 * s)
         n_layers = getattr(spec.config, "n_layers", 1)

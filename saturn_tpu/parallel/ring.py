@@ -28,10 +28,12 @@ from saturn_tpu.models.loss import pretraining_loss
 from saturn_tpu.ops.ring import ring_loss_and_grads
 from saturn_tpu.parallel import sharding as shr
 from saturn_tpu.parallel.spmd_base import SPMDTechnique
+from saturn_tpu.core.strategy import Techniques
 
 
 class RingSequenceParallel(SPMDTechnique):
     name = "ring"
+    technique = Techniques.RING
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         sp = config.get("sp", 2)  # same default as _model_overrides
@@ -72,6 +74,7 @@ class RingSequenceParallel(SPMDTechnique):
         return out
 
     def make_step_fns(self, spec, task, config, mesh, ds):
+        self._require_no_aux(spec)  # shard_map loss path would drop an aux loss
         # init runs OUTSIDE shard_map: use a dense-attention twin (identical
         # param tree — seq parallelism adds no params) for shape/init.
         plain = dict(self._model_overrides(config))

@@ -152,6 +152,18 @@ class SPMDTechnique(BaseTechnique):
         objective consistent across interval-boundary technique switches."""
         return spec.apply_with_aux_fn is not None
 
+    def _require_no_aux(self, spec: Any) -> None:
+        """Execution-time guard mirroring the candidate_configs check:
+        build()/make_step_fns called directly with an aux-loss model on a
+        schedule that would drop the aux term must fail loudly, not train a
+        silently different objective."""
+        if self._aux_incompatible(spec):
+            raise ValueError(
+                f"{self.name}: model has an auxiliary loss (apply_with_aux_fn) "
+                f"that this technique's custom schedule would drop; use a "
+                f"dense technique (dp/fsdp/tp/ep) for aux-loss models"
+            )
+
     def step_fns_from_loss_and_grads(
         self, init_params: Any, task: Any, loss_and_grads: Any
     ) -> Tuple[Any, Any]:
@@ -189,6 +201,30 @@ class SPMDTechnique(BaseTechnique):
         out = {}
         if "remat" in config:
             out["remat"] = config["remat"]
+        if config.get("attention"):
+            out["attention"] = config["attention"]
+        return out
+
+    def _with_attention_variants(
+        self, task: Any, grid: List[Dict[str, Any]]
+    ) -> List[Dict[str, Any]]:
+        """Cross an autotune grid with {dense, flash} attention when the
+        Pallas kernel can lower for this task's model. Dense first per base
+        config; the trial runner keeps whichever measures faster — the
+        empirically-selected-config premise of the whole system
+        (``PerformanceEvaluator.py:101-115``)."""
+        from saturn_tpu.ops.flash import flash_supported
+
+        try:
+            cfg = task.get_model().config
+        except Exception:
+            return grid
+        if getattr(cfg, "attention", None) is None or not flash_supported(cfg):
+            return grid
+        out: List[Dict[str, Any]] = []
+        for c in grid:
+            out.append(c)
+            out.append(dict(c, attention="flash"))
         return out
 
     def build(

@@ -13,10 +13,12 @@ from typing import Any, Dict, List, Tuple
 
 from saturn_tpu.parallel import sharding as shr
 from saturn_tpu.parallel.spmd_base import SPMDTechnique
+from saturn_tpu.core.strategy import Techniques
 
 
 class TensorParallel(SPMDTechnique):
     name = "tp"
+    technique = Techniques.TENSOR
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         tp = config.get("tp", min(n_devices, 2))
@@ -40,4 +42,4 @@ class TensorParallel(SPMDTechnique):
             grid.append({"tp": tp, "remat": False, "zero": False})
             grid.append({"tp": tp, "remat": True, "zero": True})
             tp <<= 1
-        return grid
+        return self._with_attention_variants(task, grid)

@@ -12,10 +12,12 @@ from __future__ import annotations
 from typing import Any, Dict, List
 
 from saturn_tpu.parallel.ring import RingSequenceParallel
+from saturn_tpu.core.strategy import Techniques
 
 
 class UlyssesSequenceParallel(RingSequenceParallel):
     name = "ulysses"
+    technique = Techniques.ULYSSES
 
     def candidate_configs(self, task, n_devices) -> List[Dict[str, Any]]:
         grid = super().candidate_configs(task, n_devices)

@@ -69,12 +69,65 @@ class Plan:
         self.dependencies = deps
 
 
+def warm_schedule(
+    task_list: List,
+    topology: SliceTopology,
+    previous: Plan,
+    ordering_slack: float = 1.0,
+) -> Optional[Plan]:
+    """Fix-and-optimize warm start: keep each task's previous (size, block)
+    choice, list-schedule starts under CURRENT runtimes in previous start
+    order. O(N² log N), always feasible — the analog of the reference seeding
+    Gurobi with last interval's solution (``milp.py:103-104,151-155,323``).
+
+    Returns None if any task lacks a previous assignment or its previous
+    choice no longer exists (strategy became infeasible / capacity changed).
+    """
+    pinned: List[Tuple[object, int, Block, float]] = []  # (task, size, blk, rt)
+    for t in task_list:
+        a = previous.assignments.get(t.name)
+        if a is None:
+            return None
+        strat = t.feasible_strategies().get(a.apportionment)
+        if strat is None or a.block.end > topology.capacity:
+            return None
+        pinned.append((t, a.apportionment, a.block, strat.runtime))
+
+    # Previous start order preserves the incumbent schedule's structure.
+    pinned.sort(key=lambda p: previous.assignments[p[0].name].start)
+
+    events: Dict[int, List[Tuple[float, float]]] = {
+        d: [] for d in range(topology.capacity)
+    }
+
+    def earliest_free(blk: Block, duration: float) -> float:
+        busy = sorted(iv for d in range(blk.offset, blk.end) for iv in events[d])
+        t0 = 0.0
+        for s, e in busy:
+            if t0 + duration <= s:
+                break
+            t0 = max(t0, e)
+        return t0
+
+    assignments: Dict[str, Assignment] = {}
+    for t, size, blk, rt in pinned:
+        st = earliest_free(blk, rt + ordering_slack)
+        for d in range(blk.offset, blk.end):
+            events[d].append((st, st + rt + ordering_slack))
+        assignments[t.name] = Assignment(size, blk, st, rt)
+    makespan = max((a.start + a.runtime for a in assignments.values()), default=0.0)
+    plan = Plan(assignments=assignments, makespan=makespan)
+    plan.compute_dependencies()
+    return plan
+
+
 def solve(
     task_list: List,
     topology: SliceTopology,
     time_limit: Optional[float] = None,
     ordering_slack: float = 1.0,
     milp_task_limit: int = 12,
+    warm: Optional[Plan] = None,
 ) -> Plan:
     """Build and solve the joint strategy/placement/schedule MILP.
 
@@ -85,6 +138,13 @@ def solve(
     Above ``milp_task_limit`` tasks, the exact MILP's pairwise big-M
     constraints explode (O(N²·devices) rows); the native C++ scheduler
     (``native/spase.cpp``) takes over — same option set, validated plan.
+
+    ``warm`` (the previous interval's plan) warm-starts both paths, parity
+    with the reference's ``warmStart=True`` (``milp.py:323``): the exact MILP
+    gets the fix-and-optimize makespan as an upper-bound cut (scipy's HiGHS
+    wrapper cannot inject an incumbent) and returns the warm plan instead of
+    greedy when the time limit strikes out; the native search is seeded with
+    the previous (size, block) choices.
     """
     for t in task_list:
         if not t.feasible_strategies():
@@ -94,19 +154,55 @@ def solve(
                 f"task {t.name}: no strategy fits topology capacity {topology.capacity}"
             )
 
+    wplan = (
+        warm_schedule(task_list, topology, warm, ordering_slack)
+        if warm is not None
+        else None
+    )
+
     if len(task_list) > milp_task_limit:
         from saturn_tpu.solver import native_sched
 
         plan = native_sched.solve_native(
             task_list, topology,
-            time_limit=min(time_limit or 5.0, 5.0),
+            # honor an explicit caller budget (e.g. orchestrate's interval/2);
+            # 5s is only the default when none was given.
+            time_limit=time_limit if time_limit is not None else 5.0,
             ordering_slack=ordering_slack,
+            warm=warm,
         )
         if plan is not None:
             log.info("large batch (%d tasks): native scheduler makespan %.1fs",
                      len(task_list), plan.makespan)
+            if wplan is not None and wplan.makespan < plan.makespan:
+                return wplan
             return plan
+        if wplan is not None:
+            return wplan
         return greedy_plan(task_list, topology)
+
+    # Cheap native pass first (~0.1-0.2s at these sizes): its plan is a
+    # guaranteed-feasible incumbent that (a) upper-bounds the MILP via a cut
+    # and (b) floors the result quality if HiGHS strikes out. Measured
+    # (benchmarks/solver_quality.py): at >= 8 tasks with rich option sets the
+    # exact solver rarely proves optimality inside a 30s budget and the
+    # native search often leads — combining them is never worse than either.
+    # Its cost (incl. a possible first-call g++ build) is deducted from the
+    # caller's budget below so solve() never overruns time_limit.
+    import time as _time
+
+    from saturn_tpu.solver import native_sched
+
+    t_pre = _time.perf_counter()
+    nplan = native_sched.solve_native(
+        task_list, topology, time_limit=min(1.0, time_limit or 1.0),
+        ordering_slack=ordering_slack, warm=warm,
+    )
+    if time_limit is not None:
+        time_limit = max(0.1, time_limit - (_time.perf_counter() - t_pre))
+    incumbent = nplan
+    if wplan is not None and (incumbent is None or wplan.makespan < incumbent.makespan):
+        incumbent = wplan
 
     m = Model("spase")
     # Joint (strategy,block) choice per task.
@@ -193,15 +289,21 @@ def solve(
     # Tiny pressure toward early starts (keeps solutions canonical).
     m.minimize(makespan + sum((sta[n] for n in names), Expr()) * (1e-6 / max(len(names), 1)))
 
+    if incumbent is not None:
+        # Incumbent cut (native and/or warm fix-and-optimize plan): feasible,
+        # so its makespan upper-bounds the optimum — prunes every
+        # branch-and-bound node whose relaxation exceeds it.
+        m.add(makespan <= incumbent.makespan + 1e-6 * max(incumbent.makespan, 1.0))
+
     res = m.solve(time_limit=time_limit)
     if not res.ok:
-        from saturn_tpu.solver import native_sched
-
-        log.warning("MILP infeasible/error — falling back to native/greedy")
-        plan = native_sched.solve_native(
-            task_list, topology, time_limit=1.0, ordering_slack=ordering_slack
-        )
-        return plan if plan is not None else greedy_plan(task_list, topology)
+        if incumbent is not None:
+            # Timed out without beating the cut: the incumbent IS the answer
+            # (never worse than the native/previous-interval plan).
+            log.info("MILP timeout — keeping native/warm incumbent plan")
+            return incumbent
+        log.warning("MILP infeasible/error — falling back to greedy")
+        return greedy_plan(task_list, topology)
 
     assignments: Dict[str, Assignment] = {}
     for t in task_list:
@@ -277,6 +379,7 @@ def resolve(
     interval: float,
     threshold: float = 0.0,
     time_limit: Optional[float] = None,
+    warm_budget_frac: float = 0.25,
 ) -> Plan:
     """Introspective re-solve with compare-and-swap (``milp.py:354-444``).
 
@@ -284,9 +387,24 @@ def resolve(
     shrank (``milp.py:376-379``), or (c) the fresh makespan beats the slid-down
     old plan by more than ``threshold`` (``milp.py:394-427``). Otherwise keep
     the old plan with all start times slid down by ``interval``
-    (``milp.py:429-442``).
+    (``milp.py:429-442``). The previous plan also warm-starts the re-solve
+    (reference ``warmStart=True``, ``milp.py:323``) — and because the warm
+    fix-and-optimize plan is a guaranteed-feasible incumbent no worse than
+    last interval's schedule, the re-solve only gets ``warm_budget_frac`` of
+    the caller's time budget: a long proof phase buys nothing when any
+    timeout falls back to the warm plan. This is where the reference's Gurobi
+    warm start saved its time too (incumbent reuse, ``milp.py:323``); interval
+    re-solves are cheap, only the cold initial solve pays the full budget.
     """
-    fresh = solve(task_list, topology, time_limit=time_limit)
+    tl = time_limit
+    if previous is not None and time_limit is not None:
+        # Reduce the budget only when the warm incumbent actually exists —
+        # if the task set changed (new task, choice now infeasible) the
+        # fix-and-optimize floor is unavailable and the re-solve must get
+        # the full budget like a cold solve.
+        if warm_schedule(task_list, topology, previous) is not None:
+            tl = max(1.0, time_limit * warm_budget_frac)
+    fresh = solve(task_list, topology, time_limit=tl, warm=previous)
     if previous is None:
         return fresh
 

@@ -23,20 +23,24 @@ _FN = None
 
 
 def _fn():
-    """Resolve and type the ``spase_solve`` symbol once."""
+    """Resolve and type the ``spase_solve_v2`` symbol once (None if
+    the library is stale/missing — graceful fallback, never a crash)."""
     global _FN
     if _FN is None:
         lib = native.load("spase")
         if lib is None:
             _FN = False
         else:
-            f = lib.spase_solve
+            f = getattr(lib, "spase_solve_v2", None)
+            if f is None:  # stale prebuilt .so from an older ABI
+                _FN = False
+                return None
             ip = ctypes.POINTER(ctypes.c_int)
             dp = ctypes.POINTER(ctypes.c_double)
             f.argtypes = [
                 ctypes.c_int, ip, ip, ip, dp,
                 ctypes.c_int, ctypes.c_double, ctypes.c_double,
-                ctypes.c_uint64, ip, dp, dp,
+                ctypes.c_uint64, ip, ip, dp, dp,
             ]
             f.restype = ctypes.c_int
             _FN = f
@@ -53,11 +57,15 @@ def solve_native(
     time_limit: float = 1.0,
     ordering_slack: float = 1.0,
     seed: int = 0,
+    warm=None,
 ):
     """Schedule via libspase; returns a ``Plan`` or None if unavailable.
 
     Builds the identical option set the MILP enumerates (feasible strategies
     × aligned blocks, ``milp.solve``), calls the C++ core, validates, decodes.
+    ``warm`` (a previous ``Plan``) seeds the native search with each task's
+    previous (size, block) choice — the analog of the reference's Gurobi
+    ``warmStart`` (``milp.py:323``).
     """
     from saturn_tpu.solver.milp import Assignment, Plan
 
@@ -92,10 +100,24 @@ def solve_native(
     c_starts = (ctypes.c_double * n)()
     c_mk = ctypes.c_double()
 
+    c_warm = None
+    if warm is not None:
+        widx = [-1] * n
+        for i, t in enumerate(task_list):
+            a = warm.assignments.get(t.name)
+            if a is None:
+                continue
+            for oi, (s, b, _) in enumerate(per_task[i]):
+                if s == a.apportionment and b.offset == a.block.offset:
+                    widx[i] = oi
+                    break
+        if any(w >= 0 for w in widx):
+            c_warm = (ctypes.c_int * n)(*widx)
+
     rc = fn(
         n, c_counts, c_offs, c_sizes, c_rts, topology.capacity,
         float(time_limit), float(ordering_slack), seed,
-        c_chosen, c_starts, ctypes.byref(c_mk),
+        c_warm, c_chosen, c_starts, ctypes.byref(c_mk),
     )
     if rc != 0:
         log.warning("libspase returned %d — falling back", rc)

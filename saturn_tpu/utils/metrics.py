@@ -31,8 +31,15 @@ class MetricsWriter:
         rec = {"ts": time.time(), "kind": kind}
         rec.update(fields)
         line = json.dumps(rec, default=str)
-        with self._lock:
-            self._fh.write(line + "\n")
+        try:
+            with self._lock:
+                self._fh.write(line + "\n")
+        except ValueError:
+            # The module-level event() reads _WRITER without _CONF_LOCK, so a
+            # racing configure()/scoped() may close this file between the read
+            # and the write. Dropping the event is fine; raising inside an
+            # engine launcher thread would record a spurious task failure.
+            pass
 
     def close(self) -> None:
         with self._lock:

@@ -7,10 +7,13 @@ call), ``block_until_ready`` to sync, then time ``n`` steady-state steps.
 
 from __future__ import annotations
 
+import logging
 import timeit
 from typing import Callable, Tuple
 
 import jax
+
+log = logging.getLogger("saturn_tpu")
 
 
 def time_train_step(
@@ -50,6 +53,10 @@ def hbm_bytes_required(compiled) -> int:
     try:
         ma = compiled.memory_analysis()
         if ma is None:
+            log.warning(
+                "memory_analysis unavailable on this backend — treating "
+                "config as feasible; trial execution becomes the OOM probe"
+            )
             return 0
         total = (
             getattr(ma, "temp_size_in_bytes", 0)
@@ -58,7 +65,13 @@ def hbm_bytes_required(compiled) -> int:
             - getattr(ma, "alias_size_in_bytes", 0)
         )
         return max(0, int(total))
-    except Exception:
+    except Exception as e:
+        # Returning 0 marks every config feasible — the memory check is
+        # silently out of the loop, so say so (VERDICT r1 weak item 7).
+        log.warning(
+            "memory_analysis failed (%r) — treating config as feasible; "
+            "trial execution becomes the OOM probe", e
+        )
         return 0
 
 

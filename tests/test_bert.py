@@ -26,7 +26,8 @@ def bert_task(tmp_path):
     return Task(
         get_model=lambda **kw: build_bert("bert-test-tiny", **kw),
         get_dataloader=lambda: make_lm_dataset(
-            context_length=64, batch_size=8, vocab_size=256, n_tokens=64 * 8 * 8
+            context_length=64, batch_size=8, vocab_size=256, n_tokens=64 * 8 * 8,
+            reserved_ids=1,  # keep the [MASK] id out of the data
         ),
         loss_fn=mlm_loss,
         hparams=HParams(lr=1e-3, batch_count=8),
@@ -139,3 +140,53 @@ class TestBertExecutors:
 
         assert RingSequenceParallel().candidate_configs(bert_task, 8) == []
         assert UlyssesSequenceParallel().candidate_configs(bert_task, 8) == []
+
+
+class TestMaskIdReservation:
+    """ADVICE r1 (medium): the [MASK] id must never occur in the data."""
+
+    def test_synthetic_reserved(self):
+        from saturn_tpu.data.lm_dataset import make_lm_dataset
+
+        ds = make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256,
+            n_tokens=64 * 8 * 32, reserved_ids=1,
+        )
+        for i in range(len(ds)):
+            assert ds.batch(i).max() < 255
+
+    def test_byte_tokenizer_rejects_collision(self, tmp_path):
+        from saturn_tpu.data.lm_dataset import make_lm_dataset
+
+        p = tmp_path / "corpus.txt"
+        p.write_bytes(bytes(range(256)) * 600)
+        with pytest.raises(ValueError, match="byte tokenizer"):
+            make_lm_dataset(
+                context_length=64, batch_size=8, vocab_size=256,
+                corpus_path=str(p), tokenizer="byte", reserved_ids=1,
+            )
+        # vocab 257 leaves the top id free: accepted.
+        ds = make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=257,
+            corpus_path=str(p), tokenizer="byte", reserved_ids=1,
+        )
+        assert ds.batch(0).max() <= 255
+
+    def test_word_vocab_capped_below_mask(self, tmp_path):
+        from saturn_tpu.data.lm_dataset import make_lm_dataset
+
+        words = " ".join(f"w{i}" for i in range(300))
+        p = tmp_path / "words.txt"
+        p.write_text(words * 40)
+        ds = make_lm_dataset(
+            context_length=32, batch_size=4, vocab_size=128,
+            corpus_path=str(p), tokenizer="word", reserved_ids=1,
+        )
+        for i in range(len(ds)):
+            assert ds.batch(i).max() < 127
+
+    def test_reserved_ids_validation(self):
+        from saturn_tpu.data.lm_dataset import make_lm_dataset
+
+        with pytest.raises(ValueError, match="reserved_ids"):
+            make_lm_dataset(vocab_size=16, reserved_ids=16)

@@ -1,0 +1,146 @@
+"""Multi-host path tests (VERDICT r1 item 7): SliceTopology slice inference
+and a real 2-process ``jax.distributed`` rendezvous on CPU.
+
+The reference's multi-node story was never tested either (its solver faked 8
+GPUs/node, ``milp.py:57-62``); here slice inference is unit-tested with fake
+multi-process devices and ``core/distributed.initialize`` is smoke-tested
+with two real OS processes rendezvousing over localhost and running a
+cross-process collective (Gloo under the CPU backend).
+"""
+
+import logging
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from saturn_tpu.core.mesh import Block, SliceTopology
+
+
+class FakeDev:
+    def __init__(self, process_index=0):
+        self.process_index = process_index
+
+
+class TestSliceInference:
+    def test_two_hosts_infer_slice_size(self):
+        # 2 processes x 4 devices, interleaved on purpose: constructor must
+        # regroup slice-major (all of proc 0, then all of proc 1).
+        devs = [FakeDev(i % 2) for i in range(8)]
+        topo = SliceTopology(devs)
+        assert topo.slice_size == 4
+        assert topo.capacity == 8
+        assert [d.process_index for d in topo.devices] == [0] * 4 + [1] * 4
+
+    def test_single_host_one_slice(self):
+        devs = [FakeDev(0) for _ in range(8)]
+        topo = SliceTopology(devs)
+        assert topo.slice_size == 8
+
+    def test_uneven_groups_fall_back_to_one_slice(self):
+        # 3 + 5 devices per process: not a uniform pow2 grouping
+        devs = [FakeDev(0)] * 3 + [FakeDev(1)] * 5
+        topo = SliceTopology(devs)
+        assert topo.slice_size == 8
+
+    def test_crosses_dcn(self):
+        devs = [FakeDev(i // 4) for i in range(8)]
+        topo = SliceTopology(devs)
+        assert not topo.crosses_dcn(Block(0, 4))      # within slice 0
+        assert not topo.crosses_dcn(Block(4, 4))      # within slice 1
+        assert not topo.crosses_dcn(Block(2, 2))
+        assert topo.crosses_dcn(Block(0, 8))          # spans both slices
+        # aligned sub-slice blocks never straddle a slice boundary: with
+        # pow2 slice sizes only whole-multiple-of-slice blocks cross DCN
+        for size in (1, 2, 4):
+            for blk in topo.blocks(size):
+                assert not topo.crosses_dcn(blk)
+
+    def test_stranded_devices_warn(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="saturn_tpu"):
+            topo = SliceTopology([FakeDev(0) for _ in range(6)])
+        assert topo.capacity == 4
+        assert "stranded" in caplog.text
+
+    def test_no_warning_on_pow2(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="saturn_tpu"):
+            SliceTopology([FakeDev(0) for _ in range(8)])
+        assert "stranded" not in caplog.text
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from saturn_tpu.core import distributed
+
+    distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    # idempotency: a second call must not raise
+    distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    topo = distributed.global_topology()
+    assert topo.slice_size == 2, topo.slice_size
+    assert topo.capacity == 4
+    assert [d.process_index for d in topo.devices] == [0, 0, 1, 1]
+    from saturn_tpu.core.mesh import Block
+    assert not topo.crosses_dcn(Block(0, 2))
+    assert topo.crosses_dcn(Block(0, 4))
+
+    # cross-process collective through a global mesh (DCN-analog path)
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from saturn_tpu.core.mesh import make_submesh
+
+    mesh = make_submesh(topo.devices, ("data",))
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), np.full((4, 2), pid + 1.0, np.float32)
+    )
+    total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(x)
+    # proc0 rows sum 1.0*4*2, proc1 rows 2.0*4*2 -> 24
+    assert abs(float(total) - 24.0) < 1e-6, float(total)
+    print(f"OK {pid}")
+""")
+
+
+class TestTwoProcessRendezvous:
+    def test_initialize_and_collective(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(_WORKER)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(pid), str(port)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd="/root/repo",
+            )
+            for pid in (0, 1)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=150)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append(out)
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {pid} failed:\n{out[-2000:]}"
+            assert f"OK {pid}" in out

@@ -139,3 +139,42 @@ class TestHostOffload:
         dp.execute(tiny_task, devices8[:2], tid=0, override_batch_count=2)
         state = np.load(tiny_task.ckpt_path)
         assert state["step"] == 4
+
+
+class TestAttentionAutotune:
+    """VERDICT r1 item 3: the attention choice must be in the autotune grid
+    so the trial runner can select flash from measurement."""
+
+    def test_grid_crossed_when_flash_supported(self, tiny_task, monkeypatch):
+        import saturn_tpu.ops.flash as flash
+        from saturn_tpu.parallel.dp import DataParallel
+        from saturn_tpu.parallel.fsdp import FSDP
+
+        monkeypatch.setattr(flash, "flash_supported", lambda cfg=None: True)
+        for tech in (DataParallel(), FSDP()):
+            grid = tech.candidate_configs(tiny_task, 2)
+            assert any(c.get("attention") == "flash" for c in grid)
+            assert any("attention" not in c for c in grid)
+            # dense precedes its flash twin per base config
+            flash_idx = min(
+                i for i, c in enumerate(grid) if c.get("attention") == "flash"
+            )
+            assert flash_idx > 0
+
+    def test_grid_dense_only_off_tpu(self, tiny_task):
+        from saturn_tpu.parallel.dp import DataParallel
+
+        # CPU test mesh: flash_supported() is False, grid stays dense
+        grid = DataParallel().candidate_configs(tiny_task, 2)
+        assert all("attention" not in c for c in grid)
+
+    def test_model_override_forwards_attention(self):
+        from saturn_tpu.parallel.dp import DataParallel
+
+        out = DataParallel()._model_overrides(
+            {"remat": True, "attention": "flash"}
+        )
+        assert out == {"remat": True, "attention": "flash"}
+        assert DataParallel()._model_overrides({"remat": False}) == {
+            "remat": False
+        }

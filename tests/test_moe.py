@@ -202,3 +202,30 @@ class TestExpertParallel:
 
         params, t = ExpertParallel().search(tiny_task, devices8[:4], tid=0)
         assert params is None and t is None
+
+
+class TestAuxGuard:
+    """ADVICE r1: custom-schedule step fns must raise on aux-loss models,
+    not silently train without the load-balance term."""
+
+    def test_pp_and_streaming_offload_raise(self, moe_task, devices8):
+        from saturn_tpu.parallel.offload import HostOffload
+        from saturn_tpu.parallel.pp import Pipeline
+
+        with pytest.raises(ValueError, match="auxiliary loss"):
+            Pipeline().build(
+                moe_task, devices8[:2],
+                {"stages": 2, "microbatches": 2, "remat": False},
+            )
+        with pytest.raises(ValueError, match="auxiliary loss"):
+            HostOffload().build(
+                moe_task, devices8[:2], {"stream": True, "remat": True}
+            )
+
+    def test_ring_raises(self, moe_task, devices8):
+        from saturn_tpu.parallel.ring import RingSequenceParallel
+
+        with pytest.raises(ValueError, match="auxiliary loss"):
+            RingSequenceParallel().build(
+                moe_task, devices8[:2], {"sp": 2, "remat": False}
+            )

@@ -176,3 +176,40 @@ class TestNativeTokenizer:
         )
         b = ds.batch(0)
         assert b.shape == (4, 16) and b.dtype == np.int32
+
+
+class TestLocaleRobustness:
+    def test_parity_under_utf8_ctype_locale(self, tmp_path):
+        """ADVICE r1: classification must be ASCII-range, not std::ctype —
+        a non-C LC_CTYPE must not change how bytes >= 0x80 tokenize."""
+        import ctypes
+        import ctypes.util
+
+        from saturn_tpu.data.lm_dataset import (
+            _word_tokenize_python,
+            word_tokenize_file,
+        )
+
+        libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6")
+        libc.setlocale.restype = ctypes.c_char_p
+        LC_CTYPE = 0
+        prev = libc.setlocale(LC_CTYPE, None)
+        set_to = None
+        for loc in (b"C.UTF-8", b"en_US.UTF-8"):
+            if libc.setlocale(LC_CTYPE, loc):
+                set_to = loc
+                break
+        if set_to is None:
+            pytest.skip("no UTF-8 locale available on this host")
+        try:
+            text = "Müller naïve Σigma ß — weird bytes\n" * 6
+            p = tmp_path / "loc.txt"
+            p.write_text(text, encoding="utf-8")
+            ids, vocab = word_tokenize_file(
+                str(p), max_vocab=128, cache_dir=str(tmp_path / "cl")
+            )
+            py_ids, py_vocab = _word_tokenize_python(text.encode("utf-8"), 128)
+            assert vocab == py_vocab
+            np.testing.assert_array_equal(ids, py_ids)
+        finally:
+            libc.setlocale(LC_CTYPE, prev)
