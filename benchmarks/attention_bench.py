@@ -1,0 +1,79 @@
+"""Flash-vs-dense attention measurement (VERDICT r1 item 2/3).
+
+Times one jitted train step of GPT-2-small with `attention="dense"` vs
+`attention="flash"` (the Pallas kernel, `ops/flash.py`) on the current
+backend, at several sequence lengths. On TPU this decides the default; on
+CPU the flash path runs in interpret mode and is only a correctness check,
+so the script refuses unless --force-cpu.
+
+Run: ``python benchmarks/attention_bench.py [--preset gpt2-small]``
+Prints a markdown table for BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="gpt2-small")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seqs", type=int, nargs="+", default=[512, 1024, 2048])
+    ap.add_argument("--force-cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    if jax.default_backend() != "tpu" and not args.force_cpu:
+        raise SystemExit(
+            "refusing to 'benchmark' Pallas interpret mode on "
+            f"{jax.default_backend()}; pass --force-cpu to run anyway"
+        )
+
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+    from saturn_tpu.utils.timing import time_train_step
+
+    print(f"backend={jax.default_backend()} preset={args.preset} batch={args.batch}\n")
+    print("| seq | dense ms/step | flash ms/step | flash speedup |")
+    print("|---|---|---|---|")
+    for seq in args.seqs:
+        row = {}
+        for attn in ("dense", "flash"):
+            spec = build_gpt2(args.preset, seq_len=seq, attention=attn)
+            ds = make_lm_dataset(
+                context_length=seq, batch_size=args.batch,
+                vocab_size=spec.config.vocab_size,
+                n_tokens=seq * args.batch * 4,
+            )
+            tx = optax.adamw(3e-4)
+
+            def init_state():
+                p = spec.init_fn(jax.random.PRNGKey(0))
+                return {"params": p, "opt": tx.init(p)}
+
+            def step(state, batch):
+                def loss_of(p):
+                    return pretraining_loss(spec.apply_fn(p, batch), batch)
+
+                loss, g = jax.value_and_grad(loss_of)(state["params"])
+                up, opt = tx.update(g, state["opt"], state["params"])
+                return {"params": optax.apply_updates(state["params"], up),
+                        "opt": opt}, loss
+
+            jstep = jax.jit(step, donate_argnums=(0,))
+            state = jax.jit(init_state)()
+            batch = jnp.asarray(ds.batch(0))
+            row[attn] = time_train_step(jstep, state, batch, n_timed=10, n_warmup=3)
+        print(
+            f"| {seq} | {row['dense']*1e3:.1f} | {row['flash']*1e3:.1f} "
+            f"| {row['dense']/row['flash']:.2f}x |"
+        )
+
+
+if __name__ == "__main__":
+    main()

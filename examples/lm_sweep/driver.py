@@ -46,6 +46,8 @@ def parse_args():
                    help="scheduling interval seconds (reference default 1000)")
     p.add_argument("--techniques", nargs="+", default=None,
                    help="library names to profile (default: all registered)")
+    p.add_argument("--chip-range", type=int, nargs="+", default=None,
+                   help="sub-mesh sizes to profile (default: all powers of two)")
     p.add_argument("--corpus", default=_BUNDLED_CORPUS,
                    help="local text file to tokenize; 'synthetic' for the "
                         "deterministic Zipf stream (default: the bundled "
@@ -68,6 +70,9 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        # re-runs skip XLA compiles (single-core CI hosts; tests/conftest.py)
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     import saturn_tpu
     from saturn_tpu import HParams, Task, library
@@ -100,6 +105,7 @@ def main():
             ),
             loss_fn=pretraining_loss,
             hparams=HParams(lr=args.lrs[0], batch_count=args.batch_count),
+            chip_range=args.chip_range,
             name=f"{args.preset}-bs{bs}-lr{args.lrs[0]:g}",
             save_dir=args.save_dir,
         )

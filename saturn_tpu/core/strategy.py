@@ -30,6 +30,10 @@ class Techniques(enum.Enum):
     RING = 6        # sequence/context parallelism with ring attention
     ULYSSES = 7     # sequence parallelism with all-to-all head resharding
     EXPERT = 8      # expert parallelism for mixture-of-experts models
+    # Aliases matching the reference's member names (``Strategy.py:31-34``)
+    # so users switching from it can keep their spelling.
+    SPILLED = 4     # reference's name for offload
+    MEGATRON = 5    # reference's name for tensor parallelism
 
 
 @dataclass

@@ -143,7 +143,11 @@ class Task:
         return os.path.join(self.save_dir, f"{self.name}.npz")
 
     def has_ckpt(self) -> bool:
-        return os.path.exists(self.ckpt_path)
+        from saturn_tpu.utils import checkpoint as _ckpt
+
+        # routes through the checkpoint module so an in-flight async save
+        # counts as existing (utils/checkpoint.py save_async)
+        return _ckpt.exists(self.ckpt_path)
 
     def clear_ckpt(self) -> None:
         if self.has_ckpt():

@@ -35,6 +35,7 @@ def orchestrate(
     threshold: float = 0.0,
     solver_time_limit: Optional[float] = None,
     failure_policy: str = "raise",
+    max_task_retries: int = 1,
     metrics_path: Optional[str] = None,
     trace_dir: Optional[str] = None,
 ) -> dict:
@@ -43,17 +44,21 @@ def orchestrate(
     ``interval``: seconds of execution per scheduling round (reference default
     1000, ``orchestrator.py:32``). ``threshold``: makespan improvement needed
     to adopt a re-solved plan (``milp.py:376-379``). ``failure_policy``:
-    ``"raise"`` (reference crash-the-batch semantics) or ``"drop"`` (evict
-    the failed task, keep the rest running). ``metrics_path`` appends JSONL
-    events (``utils/metrics.py``); ``trace_dir`` wraps the run in a
-    jax.profiler trace.
+    ``"raise"`` (reference crash-the-batch semantics), ``"drop"`` (evict the
+    failed task, keep the rest running), or ``"retry"`` (keep the failed task
+    in the batch for up to ``max_task_retries`` more attempts — it resumes
+    from its last checkpoint at the next interval — then evict like
+    ``"drop"``). ``metrics_path`` appends JSONL events (``utils/metrics.py``);
+    ``trace_dir`` wraps the run in a jax.profiler trace.
 
     Returns ``{"completed": [names], "failed": {name: error string}}``.
     """
     if log:
         logging.basicConfig(level=logging.INFO)
-    if failure_policy not in ("raise", "drop"):
-        raise ValueError(f"failure_policy must be 'raise' or 'drop', got {failure_policy!r}")
+    if failure_policy not in ("raise", "drop", "retry"):
+        raise ValueError(
+            f"failure_policy must be 'raise', 'drop' or 'retry', got {failure_policy!r}"
+        )
     topo = topology if topology is not None else SliceTopology()
     names = [t.name for t in task_list]
     if len(set(names)) != len(names):
@@ -72,6 +77,35 @@ def orchestrate(
     task_list = list(task_list)
     all_completed: List[str] = []
     all_failed: dict = {}
+    retries: dict = {}  # task name -> failed attempts so far
+    try:
+        return _orchestrate_loop(
+            task_list, topo, interval, threshold, tlimit, failure_policy,
+            max_task_retries, metrics_path, trace_dir,
+            all_completed, all_failed, retries,
+        )
+    finally:
+        import sys
+
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        try:
+            # join outstanding async checkpoint writes on EVERY exit path —
+            # a caller catching a failure must still see landed checkpoints
+            ckpt.flush()
+        except Exception:
+            if sys.exc_info()[1] is None:
+                raise  # clean exit: surface the write failure
+            logger.exception(
+                "async checkpoint flush failed during error unwind"
+            )
+
+
+def _orchestrate_loop(
+    task_list, topo, interval, threshold, tlimit, failure_policy,
+    max_task_retries, metrics_path, trace_dir,
+    all_completed, all_failed, retries,
+) -> dict:
     with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
         plan = milp.solve(task_list, topo, time_limit=tlimit)  # initial blocking solve
         logger.info("initial plan: makespan %.1fs, %d tasks", plan.makespan, len(task_list))
@@ -94,24 +128,68 @@ def orchestrate(
                 if run_tasks:
                     errors = engine.execute(
                         run_tasks, batches, interval, plan, topo,
-                        failure_policy=failure_policy,
+                        failure_policy="raise" if failure_policy == "raise" else "drop",
                     )
                 elif remaining:
                     # nothing scheduled inside this interval (all starts beyond
                     # it): the slide in resolve() brings work forward next round.
                     logger.info("idle interval: no task starts within %.1fs", interval)
 
-                if errors:  # failure_policy == "drop": evict failed tasks
+                if future is not None:
+                    # Join the overlapped solve BEFORE the failure handling
+                    # below mutates Task/Strategy state the solver thread
+                    # reads (retry rollback rewrites strategy runtimes).
+                    plan = future.result()
+                    future = None
+                    # Evictions happen after the solve was submitted: the
+                    # plan may still cover dropped tasks; their slots simply
+                    # idle for one interval and vanish at the next re-solve.
+                    logger.info("re-solve: makespan %.1fs", plan.makespan)
+                    metrics.event("solve", makespan_s=plan.makespan,
+                                  n_tasks=len(remaining))
+
+                if errors:  # "drop": evict failed tasks; "retry": give them
+                    # max_task_retries more intervals first
+                    by_name = {t.name: t for t in run_tasks}
+                    retried: List = []
                     for name, err in errors.items():
-                        all_failed[name] = repr(err)
-                        metrics.event("task_failed", task=name, error=repr(err))
-                        logger.warning("evicting failed task %s: %r", name, err)
-                    for t in run_tasks:
-                        if t.name in errors:
-                            release = getattr(t, "release_live_state", None)
-                            if release is not None:
-                                release()  # free HBM before the block is reused
-                    remaining = [t for t in remaining if t.name not in errors]
+                        t = by_name[name]
+                        release = getattr(t, "release_live_state", None)
+                        if release is not None:
+                            release()  # free HBM before the block is reused
+                        retries[name] = retries.get(name, 0) + 1
+                        if (
+                            failure_policy == "retry"
+                            and retries[name] <= max_task_retries
+                        ):
+                            # Roll back forecast's optimistic accounting: the
+                            # batches it pre-deducted never ran (the checkpoint
+                            # is the ground truth the retry resumes from).
+                            n = batches.get(name, 0)
+                            t.total_batches += n
+                            for s in t.strategies.values():
+                                if s.feasible:
+                                    s.runtime = s.per_batch_time * t.total_batches
+                            retried.append(t)
+                            metrics.event("task_retry", task=name,
+                                          attempt=retries[name], error=repr(err))
+                            logger.warning(
+                                "task %s failed (attempt %d/%d) — retrying "
+                                "next interval from its last checkpoint: %r",
+                                name, retries[name], max_task_retries + 1, err,
+                            )
+                        else:
+                            all_failed[name] = repr(err)
+                            metrics.event("task_failed", task=name, error=repr(err))
+                            logger.warning("evicting failed task %s: %r", name, err)
+                    keep = {t.name for t in retried}
+                    remaining = [
+                        t for t in remaining
+                        if t.name not in errors or t.name in keep
+                    ]
+                    for t in retried:
+                        if t not in remaining:
+                            remaining.append(t)  # was forecast-completed
                     completed = [t for t in completed if t.name not in errors]
 
                 for t in completed:
@@ -121,16 +199,6 @@ def orchestrate(
                     if release is not None:
                         release()  # free HBM held by finished tasks
                 task_list = remaining
-                if future is not None:
-                    plan = future.result()
-                    # Evictions happened after the solve was submitted: the
-                    # plan may still cover dropped tasks; their slots simply
-                    # idle for one interval and vanish at the next re-solve.
-                    logger.info(
-                        "re-solve: makespan %.1fs, %d tasks left",
-                        plan.makespan, len(task_list),
-                    )
-                    metrics.event("solve", makespan_s=plan.makespan, n_tasks=len(task_list))
     logger.info("orchestration complete (%d completed, %d failed)",
                 len(all_completed), len(all_failed))
     return {"completed": all_completed, "failed": all_failed}

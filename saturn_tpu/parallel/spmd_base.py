@@ -392,6 +392,9 @@ class SPMDTechnique(BaseTechnique):
                      task.name, self.name, n, float(jax.device_get(loss)))
 
         # Full train-state checkpoint (params + opt state + step): fixes the
-        # reference's dropped-optimizer wart (``FSDP.py:220``).
-        ckpt.save(task.ckpt_path, state)
+        # reference's dropped-optimizer wart (``FSDP.py:220``). The disk write
+        # overlaps the next interval (device->host copy happens here; see
+        # utils/checkpoint.save_async) — interval boundaries don't stall the
+        # gang on GB-scale npz writes.
+        ckpt.save_async(task.ckpt_path, state)
         task._live_state = (key, state)

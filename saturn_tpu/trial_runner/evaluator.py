@@ -6,11 +6,13 @@ size** (``:101-115``), seed unsearched sizes with an infeasible dummy
 (``:96-99``), scale per-batch time to total runtime (``:26``) — with two
 TPU-native differences:
 
-- Trials run **sequentially on the host that drives the slice** instead of as
-  Ray remote tasks: one Python process owns all chips, and a trial targeting a
-  size-``g`` sub-mesh simply builds a mesh over ``g`` devices. (Timing is
-  position-independent on the ICI ring, so every trial uses the block at
-  offset 0.)
+- Trials run as **threads on the host that drives the slice** instead of as
+  Ray remote tasks: one Python process owns all chips, and concurrent trials
+  of sub-mesh size ``g`` run on *disjoint* aligned blocks (the analog of the
+  reference scheduling ``num_gpus=g`` remotes across the node,
+  ``PerformanceEvaluator.py:74-84``). Timing is position-independent on the
+  ICI ring. On the CPU test platform trials stay sequential — virtual
+  devices share host cores, so concurrency would skew the measurements.
 - Infeasible configs are rejected by XLA memory analysis inside each
   technique's ``search`` (see ``SPMDTechnique._fits_memory``) rather than
   try/except CUDA OOM probing.
@@ -19,7 +21,10 @@ TPU-native differences:
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 import timeit
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
 from saturn_tpu import library as lib
@@ -39,6 +44,7 @@ def search(
     topology: Optional[SliceTopology] = None,
     metrics_path: Optional[str] = None,
     trace_dir: Optional[str] = None,
+    parallel_trials: Optional[int] = None,
 ) -> None:
     """Fill ``task.strategies`` for every task in place.
 
@@ -46,15 +52,22 @@ def search(
     default library if the user registered nothing — the reference required
     explicit registration, ``WikiText103.py:53-54``). ``metrics_path``
     appends per-trial JSONL events; ``trace_dir`` wraps the sweep in a
-    jax.profiler trace.
+    jax.profiler trace. ``parallel_trials`` caps how many same-size trials
+    run concurrently on disjoint blocks (default: 4 on accelerators, 1 on
+    the CPU test platform where concurrency would skew timings).
     """
     if log:
         logging.basicConfig(level=logging.INFO)
     with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
-        _search_inner(tasks, technique_names, topology)
+        _search_inner(tasks, technique_names, topology, parallel_trials)
 
 
-def _search_inner(tasks, technique_names, topology) -> None:
+def _default_parallelism(topo: SliceTopology) -> int:
+    platform = getattr(topo.devices[0], "platform", "cpu") if topo.devices else "cpu"
+    return 4 if platform != "cpu" else 1
+
+
+def _search_inner(tasks, technique_names, topology, parallel_trials=None) -> None:
     topo = topology if topology is not None else SliceTopology()
     if technique_names is None and not lib.registered_names():
         lib.register_default_library()
@@ -76,21 +89,22 @@ def _search_inner(tasks, technique_names, topology) -> None:
         "trial runner: %d trials queued (≤ ~%.0f min)", len(grid), len(grid) * 1.0
     )
 
-    tid = 0
-    for task, g, name, tech in grid:
-        devices = topo.blocks(g)[0].devices_of(topo.devices)
+    workers = parallel_trials if parallel_trials is not None else _default_parallelism(topo)
+    update_lock = threading.Lock()
+
+    def run_trial(tid, task, g, name, tech, block):
+        devices = block.devices_of(topo.devices)
         t0 = timeit.default_timer()
         try:
             params, per_batch_time = tech.search(task, devices, tid)
         except Exception as e:  # a broken trial must not kill the sweep (``:27-28``)
             logger.info("trial (%s, g=%d, %s) raised: %r", task.name, g, name, e)
             params, per_batch_time = None, None
-        tid += 1
         if params is None or per_batch_time is None:
             logger.info("trial (%s, g=%d, %s): infeasible", task.name, g, name)
             metrics.event("trial", task=task.name, size=g, technique=name,
                           feasible=False)
-            continue
+            return
         total = per_batch_time * task.total_batches  # reference ``:26``
         metrics.event("trial", task=task.name, size=g, technique=name,
                       feasible=True, per_batch_s=per_batch_time,
@@ -99,16 +113,55 @@ def _search_inner(tasks, technique_names, topology) -> None:
             "trial (%s, g=%d, %s): %.4fs/batch, est total %.1fs (trial took %.1fs)",
             task.name, g, name, per_batch_time, total, timeit.default_timer() - t0,
         )
-        cur = task.strategies.get(g)
-        # fastest feasible technique per size wins (``:101-115``)
-        if cur is None or not cur.feasible or total < cur.runtime:
-            task.strategies[g] = Strategy(
-                executor=tech,
-                apportionment=g,
-                params=params,
-                runtime=total,
-                per_batch_time=per_batch_time,
-            )
+        with update_lock:
+            cur = task.strategies.get(g)
+            # fastest feasible technique per size wins (``:101-115``)
+            if cur is None or not cur.feasible or total < cur.runtime:
+                task.strategies[g] = Strategy(
+                    executor=tech,
+                    apportionment=g,
+                    params=params,
+                    runtime=total,
+                    per_batch_time=per_batch_time,
+                )
+
+    if workers <= 1:
+        for tid, (task, g, name, tech) in enumerate(grid):
+            run_trial(tid, task, g, name, tech, topo.blocks(g)[0])
+    else:
+        # Concurrent same-size trials on DISJOINT blocks (the reference's
+        # Ray fan-out, ``:74-84``, without Ray): a bounded pool per size
+        # class, each in-flight trial holding its own block from a free list.
+        by_size: dict = {}
+        for tid, item in enumerate(grid):
+            by_size.setdefault(item[1], []).append((tid, item))
+        for g, items in by_size.items():
+            blocks = topo.blocks(g)
+            n_workers = min(workers, len(blocks), len(items))
+            if n_workers <= 1:
+                for tid, (task, g_, name, tech) in items:
+                    run_trial(tid, task, g_, name, tech, blocks[0])
+                continue
+            free: queue.Queue = queue.Queue()
+            for b in blocks[:n_workers]:
+                free.put(b)
+
+            def with_block(tid, task, g_, name, tech):
+                block = free.get()
+                try:
+                    run_trial(tid, task, g_, name, tech, block)
+                finally:
+                    free.put(block)
+
+            with ThreadPoolExecutor(
+                max_workers=n_workers, thread_name_prefix=f"trial-g{g}"
+            ) as pool:
+                futures = [
+                    pool.submit(with_block, tid, task, g_, name, tech)
+                    for tid, (task, g_, name, tech) in items
+                ]
+                for f in futures:
+                    f.result()
 
     # Seed unsearched sizes with an infeasible dummy (``:96-99``) so the
     # solver's bookkeeping sees a complete table.

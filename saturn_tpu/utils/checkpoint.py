@@ -17,14 +17,18 @@ host arrays onto a freshly-initialized template state and the caller then
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
+import threading
 from typing import Any, Dict
 
 import jax
 import numpy as np
 
 from saturn_tpu.utils.treepath import path_str as _path_str
+
+log = logging.getLogger("saturn_tpu")
 
 
 def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
@@ -44,9 +48,7 @@ def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
     return out
 
 
-def save(path: str, tree: Any) -> None:
-    """Atomically write a pytree checkpoint to ``path`` (an ``.npz`` file)."""
-    arrays = flatten_to_host(tree)
+def _write_atomic(path: str, arrays: Dict[str, np.ndarray]) -> None:
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
@@ -59,6 +61,81 @@ def save(path: str, tree: Any) -> None:
             os.unlink(tmp)
 
 
+def save(path: str, tree: Any) -> None:
+    """Atomically write a pytree checkpoint to ``path`` (an ``.npz`` file)."""
+    _write_atomic(path, flatten_to_host(tree))
+
+
+# --------------------------------------------------------------- async writes
+# End-of-interval checkpoints are GB-scale (full train state incl. optimizer):
+# the device->host transfer must happen synchronously (the engine may donate
+# the buffers into the next interval's first step), but the DISK write can
+# overlap the next interval's compute. One writer thread per path; restore()
+# and a second save() to the same path wait for the in-flight write first.
+# A failed write is recorded per path and re-raised at the next join point
+# (exists/restore/save_async/flush) — a checkpoint that never hit disk must
+# not be silently reported as saved.
+_PENDING: Dict[str, threading.Thread] = {}
+_FAILED: Dict[str, BaseException] = {}
+_PENDING_LOCK = threading.Lock()
+
+
+def _wait_pending(path: str) -> None:
+    key = os.path.abspath(path)
+    with _PENDING_LOCK:
+        t = _PENDING.get(key)
+    if t is not None:
+        t.join()
+    with _PENDING_LOCK:
+        err = _FAILED.pop(key, None)
+    if err is not None:
+        raise RuntimeError(f"async checkpoint write to {path} failed") from err
+
+
+def save_async(path: str, tree: Any) -> None:
+    """``save`` with the disk write off the critical path.
+
+    Blocks only for the device->host transfer (``flatten_to_host``); the
+    ``np.savez`` + atomic rename happens in a background thread. A crash
+    mid-write leaves the previous checkpoint intact (same atomicity as
+    ``save``). ``flush()`` joins all outstanding writes; a failed write
+    re-raises from the next join point on the same path (or ``flush``).
+    """
+    _wait_pending(path)  # at most one in-flight write per path
+    arrays = flatten_to_host(tree)
+    key = os.path.abspath(path)
+
+    def write():
+        try:
+            _write_atomic(path, arrays)
+        except BaseException as e:  # re-raised at the next join point
+            log.exception("async checkpoint write to %s failed", path)
+            with _PENDING_LOCK:
+                _FAILED[key] = e
+        finally:
+            with _PENDING_LOCK:
+                if _PENDING.get(key) is threading.current_thread():
+                    del _PENDING[key]
+
+    t = threading.Thread(target=write, name=f"ckpt-{os.path.basename(path)}", daemon=True)
+    with _PENDING_LOCK:
+        _PENDING[key] = t
+    t.start()
+
+
+def flush() -> None:
+    """Join every outstanding async write; re-raise the first failure."""
+    with _PENDING_LOCK:
+        threads = list(_PENDING.values())
+    for t in threads:
+        t.join()
+    with _PENDING_LOCK:
+        errs = dict(_FAILED)
+        _FAILED.clear()
+    for path, err in errs.items():
+        raise RuntimeError(f"async checkpoint write to {path} failed") from err
+
+
 def restore(path: str, template: Any) -> Any:
     """Map saved arrays onto ``template``'s structure (host numpy leaves).
 
@@ -66,6 +143,7 @@ def restore(path: str, template: Any) -> Any:
     are replaced by the saved arrays with dtype preserved from the template so
     a bf16 param set restores as bf16 even though numpy stored it widened.
     """
+    _wait_pending(path)  # an async save to this path may still be in flight
     with np.load(path) as data:
         saved = {k: data[k] for k in data.files}
 
@@ -88,4 +166,7 @@ def restore(path: str, template: Any) -> Any:
 
 
 def exists(path: str) -> bool:
+    """True if a checkpoint exists (joining any in-flight async write first,
+    so a just-scheduled save counts)."""
+    _wait_pending(path)
     return os.path.exists(path)

@@ -97,6 +97,30 @@ class FlakyTechnique(BaseTechnique):
         np.savez(task.ckpt_path, step=override_batch_count or 0)
 
 
+class FlakyOnceTechnique(BaseTechnique):
+    """Fails the FIRST execute call per task, succeeds afterwards."""
+
+    name = "flaky-once"
+    _failed = None
+
+    def __init__(self):
+        self._failed = set()
+
+    def search(self, task, devices, tid):
+        return {}, 0.01
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        if task.name.startswith("flaky") and task.name not in self._failed:
+            self._failed.add(task.name)
+            raise RuntimeError(f"injected one-shot failure for {task.name}")
+        import numpy as np
+
+        prev = 0
+        if task.has_ckpt():
+            prev = int(np.load(task.ckpt_path)["step"])
+        np.savez(task.ckpt_path, step=prev + (override_batch_count or 0))
+
+
 def mk_task(name, tmp_path, batches=4):
     t = Task(
         get_model=lambda **kw: None,
@@ -176,4 +200,88 @@ class TestFailureIsolation:
     def test_invalid_policy_rejected(self, tmp_path):
         saturn_tpu, good, _ = self._setup(tmp_path)
         with pytest.raises(ValueError, match="failure_policy"):
-            saturn_tpu.orchestrate([good], interval=10.0, failure_policy="retry")
+            saturn_tpu.orchestrate([good], interval=10.0, failure_policy="explode")
+
+    def test_retry_policy_recovers_flaky_task(self, tmp_path):
+        """A task that fails once then succeeds must complete under
+        failure_policy='retry' (resuming at the next interval)."""
+        import saturn_tpu
+
+        library.register("flaky-once", FlakyOnceTechnique)
+        tech = FlakyOnceTechnique()
+        t1 = mk_task("flaky-once-task", tmp_path)
+        t2 = mk_task("steady-task", tmp_path)
+        for t in (t1, t2):
+            t.strategies[1] = Strategy(tech, 1, {}, 1.0, per_batch_time=0.01)
+        res = saturn_tpu.orchestrate(
+            [t1, t2], interval=10.0, failure_policy="retry",
+            metrics_path=str(tmp_path / "mr.jsonl"),
+        )
+        assert sorted(res["completed"]) == ["flaky-once-task", "steady-task"]
+        assert res["failed"] == {}
+        kinds = [e["kind"] for e in read_events(str(tmp_path / "mr.jsonl"))]
+        assert "task_retry" in kinds and "task_failed" not in kinds
+        # the retried attempt re-ran the rolled-back batches
+        import numpy as np
+
+        assert int(np.load(t1.ckpt_path)["step"]) == 4
+
+    def test_retry_policy_evicts_after_budget(self, tmp_path):
+        """An always-failing task is evicted once retries are exhausted."""
+        saturn_tpu, good, bad = self._setup(tmp_path)
+        res = saturn_tpu.orchestrate(
+            [good, bad], interval=10.0, failure_policy="retry",
+            max_task_retries=2, metrics_path=str(tmp_path / "me.jsonl"),
+        )
+        assert res["completed"] == ["good-task"]
+        assert "bad-task" in res["failed"]
+        events = read_events(str(tmp_path / "me.jsonl"))
+        assert sum(e["kind"] == "task_retry" for e in events) == 2
+        assert sum(e["kind"] == "task_failed" for e in events) == 1
+
+
+class TestAsyncCheckpoint:
+    """save_async: device->host copy synchronous, disk write overlapped;
+    exists/restore/flush join the in-flight write (no torn reads)."""
+
+    def test_roundtrip_and_visibility(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        tree = {"a": jnp.arange(8.0), "b": {"c": jnp.ones((4, 4))}}
+        p = str(tmp_path / "s.npz")
+        ckpt.save_async(p, tree)
+        assert ckpt.exists(p)  # joins the write
+        out = ckpt.restore(p, tree)
+        np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(8.0))
+
+    def test_second_save_wins(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        p = str(tmp_path / "s.npz")
+        ckpt.save_async(p, {"x": jnp.zeros(4)})
+        ckpt.save_async(p, {"x": jnp.ones(4)})  # waits for the first
+        ckpt.flush()
+        out = ckpt.restore(p, {"x": jnp.zeros(4)})
+        np.testing.assert_array_equal(np.asarray(out["x"]), np.ones(4))
+
+    def test_write_failure_surfaces(self, tmp_path):
+        """A failed background write must re-raise at the next join point,
+        not silently report the checkpoint as saved."""
+        import jax.numpy as jnp
+
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file where a directory is needed")
+        bad = str(blocker / "sub" / "s.npz")  # makedirs will fail
+        ckpt.save_async(bad, {"x": jnp.zeros(2)})
+        with pytest.raises(RuntimeError, match="async checkpoint write"):
+            ckpt.flush()
+        # the error is consumed; later flushes are clean
+        ckpt.flush()

@@ -56,3 +56,22 @@ def test_search_then_orchestrate(tmp_path, devices8):
         assert t.has_ckpt()
         state = np.load(t.ckpt_path)
         assert state["step"] == 8  # all batches ran exactly once
+
+
+def test_parallel_trials_fill_strategies(tmp_path, devices8):
+    """Concurrent same-size trials on disjoint blocks (the reference's Ray
+    fan-out, ``PerformanceEvaluator.py:74-84``) must fill the same strategy
+    table shape as the sequential path."""
+    topo = SliceTopology(devices8)
+    library.register_default_library()
+    t_par = make_task(tmp_path, "par", lr=1e-3)
+    t_par.chip_range = [1, 2]  # several disjoint blocks exist for each size
+    saturn_tpu.search(
+        [t_par], technique_names=["dp", "fsdp"], topology=topo,
+        parallel_trials=4,
+    )
+    feas = t_par.feasible_strategies()
+    assert set(feas) == {1, 2}
+    for s in feas.values():
+        assert s.per_batch_time > 0
+        assert s.runtime > 0

@@ -36,6 +36,7 @@ class TestDataParallel:
         assert state1["step"] == 2
         # resume on a DIFFERENT submesh size — reshard from checkpoint
         tech.execute(tiny_task, devices8[:4], tid=0, override_batch_count=3)
+        ckpt.flush()  # execute()'s disk write is async
         state2 = np.load(tiny_task.ckpt_path)
         assert state2["step"] == 5
 
@@ -69,6 +70,7 @@ class TestFSDP:
         tiny_task.strategies[2] = Strategy(dp, 2, {"remat": False}, 50.0, 0.1)
         tiny_task.select_strategy(2)
         dp.execute(tiny_task, devices8[:2], tid=0, override_batch_count=2)
+        ckpt.flush()  # execute()'s disk write is async
         state = np.load(tiny_task.ckpt_path)
         assert state["step"] == 4
 
@@ -137,6 +139,7 @@ class TestHostOffload:
         tiny_task.strategies[2] = Strategy(dp, 2, {"remat": False}, 50.0, 0.1)
         tiny_task.select_strategy(2)
         dp.execute(tiny_task, devices8[:2], tid=0, override_batch_count=2)
+        ckpt.flush()  # execute()'s disk write is async
         state = np.load(tiny_task.ckpt_path)
         assert state["step"] == 4
 
