@@ -15,6 +15,7 @@ override only the three small hooks at the bottom.
 from __future__ import annotations
 
 import logging
+import timeit as _timeit
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -381,6 +382,7 @@ class SPMDTechnique(BaseTechnique):
 
         start = task.current_batch
         loss = None
+        t0 = _timeit.default_timer()
         for i in range(n):
             batch = jax.device_put(
                 task.batch_at(start + i), bundle.batch_sharding
@@ -388,8 +390,22 @@ class SPMDTechnique(BaseTechnique):
             state, loss = bundle.compiled(state, batch)
         if loss is not None:
             # host read = reliable queue drain (see utils/timing.py note)
-            log.info("task %s [%s]: ran %d batches, loss %.4f",
-                     task.name, self.name, n, float(jax.device_get(loss)))
+            loss_val = float(jax.device_get(loss))
+            elapsed = _timeit.default_timer() - t0
+            bs = task.get_dataset().batch_size
+            sps = n * bs / max(elapsed, 1e-9)
+            # per-job samples/sec — the BASELINE.md per-job metric — and the
+            # realized per-batch time (vs the profiled estimate forecast used)
+            task.last_samples_per_sec = sps
+            from saturn_tpu.utils import metrics as _metrics
+
+            _metrics.event(
+                "task_interval", task=task.name, technique=self.name,
+                batches=n, loss=loss_val, samples_per_sec=round(sps, 2),
+                per_batch_s=elapsed / n,
+            )
+            log.info("task %s [%s]: ran %d batches, loss %.4f, %.1f samples/s",
+                     task.name, self.name, n, loss_val, sps)
 
         # Full train-state checkpoint (params + opt state + step): fixes the
         # reference's dropped-optimizer wart (``FSDP.py:220``). The disk write

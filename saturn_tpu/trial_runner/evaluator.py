@@ -11,7 +11,11 @@ TPU-native differences:
   of sub-mesh size ``g`` run on *disjoint* aligned blocks (the analog of the
   reference scheduling ``num_gpus=g`` remotes across the node,
   ``PerformanceEvaluator.py:74-84``). Timing is position-independent on the
-  ICI ring. On the CPU test platform trials stay sequential — virtual
+  ICI ring — and DCN-correct for free: with power-of-two slice sizes, every
+  aligned block of a given size has the same DCN-crossing status
+  (``core/mesh.py``), so a profile measured on block 0 prices any block the
+  solver may later pick, including the cross-slice collectives of
+  larger-than-slice sizes. On the CPU test platform trials stay sequential — virtual
   devices share host cores, so concurrency would skew the measurements.
 - Infeasible configs are rejected by XLA memory analysis inside each
   technique's ``search`` (see ``SPMDTechnique._fits_memory``) rather than
