@@ -109,8 +109,23 @@ class Task:
         self._live_state: Optional[tuple] = None
 
     def release_live_state(self) -> None:
-        """Drop the cached device state (frees HBM once the task finishes)."""
+        """Drop the cached device train state (frees HBM). Safe on a task
+        that will run again (retry path): the next interval restores from the
+        checkpoint; compiled programs stay cached."""
         self._live_state = None
+
+    def release_compiled(self) -> None:
+        """Release this task's compiled-program cache in every technique that
+        profiled it. Only for tasks that will NOT run again (completed or
+        permanently dropped) — a retried task would pay a full XLA recompile
+        (minutes at scale) for nothing."""
+        seen = set()
+        for strat in self.strategies.values():
+            ex = getattr(strat, "executor", None)
+            release = getattr(ex, "release_task", None)
+            if release is not None and id(ex) not in seen:
+                seen.add(id(ex))
+                release(self.name)
 
     # ------------------------------------------------------------------ model
     def get_model(self, **overrides):

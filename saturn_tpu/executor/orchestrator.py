@@ -182,6 +182,12 @@ def _orchestrate_loop(
                             all_failed[name] = repr(err)
                             metrics.event("task_failed", task=name, error=repr(err))
                             logger.warning("evicting failed task %s: %r", name, err)
+                            # permanently dropped: also free its compiled
+                            # programs (a retried task keeps them — recompiling
+                            # an identical program is the cost the cache avoids)
+                            release_c = getattr(t, "release_compiled", None)
+                            if release_c is not None:
+                                release_c()
                     keep = {t.name for t in retried}
                     remaining = [
                         t for t in remaining
@@ -198,6 +204,9 @@ def _orchestrate_loop(
                     release = getattr(t, "release_live_state", None)
                     if release is not None:
                         release()  # free HBM held by finished tasks
+                    release_c = getattr(t, "release_compiled", None)
+                    if release_c is not None:
+                        release_c()  # and their compiled programs
                 task_list = remaining
     logger.info("orchestration complete (%d completed, %d failed)",
                 len(all_completed), len(all_failed))

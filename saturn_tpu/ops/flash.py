@@ -78,10 +78,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(needed)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
-        kb = k_ref[0].astype(jnp.float32)                 # (BK, D)
-        vb = v_ref[0].astype(jnp.float32)
-        s = _dot(q, kb, ((1,), (1,)))                     # (BQ, BK)
+        # Matmul inputs stay in the storage dtype (bf16): the MXU computes
+        # bf16×bf16→f32 natively via preferred_element_type, while f32×f32
+        # needs multiple passes — upcasting before the dot costs ~2x. Scale
+        # is applied to the f32 scores, softmax state stays f32.
+        q = q_ref[0]                                      # (BQ, D)
+        kb = k_ref[0]                                     # (BK, D)
+        vb = v_ref[0]
+        s = _dot(q, kb, ((1,), (1,))) * scale             # (BQ, BK) f32
         if causal:
             s = jnp.where(_block_mask(iq, jk, block_q, block_k), s, NEG_INF)
         m_prev, l_prev = m_scr[:, 0], l_scr[:, 0]
@@ -90,13 +94,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         corr = jnp.exp(m_prev - m_new)
         m_scr[:, 0] = m_new
         l_scr[:, 0] = corr * l_prev + p.sum(axis=-1)
-        acc_scr[:] = corr[:, None] * acc_scr[:] + _dot(p, vb, ((1,), (0,)))
+        acc_scr[:] = corr[:, None] * acc_scr[:] + _dot(
+            p.astype(vb.dtype), vb, ((1,), (0,))
+        )
 
     @pl.when(jk == n_kv - 1)
     def _finalize():
         l = l_scr[:, 0]
         o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, 0] + jnp.log(l)
+        # lse rides a trailing singleton dim: Mosaic requires the last two
+        # block dims be (mult-of-8, mult-of-128) or equal to the array dims,
+        # so a 2-D (1, block_q) lse block cannot lower; (1, block_q, 1) can.
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l))[:, None]
 
 
 def _fwd(q, k, v, *, block_q, block_k, scale, causal):
@@ -115,11 +124,11 @@ def _fwd(q, k, v, *, block_q, block_k, scale, causal):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -147,17 +156,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(needed)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = _dot(q, kb, ((1,), (1,)))
+        # bf16 matmul inputs, f32 accumulation — see the fwd kernel note.
+        q = q_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        do = do_ref[0]
+        s = _dot(q, kb, ((1,), (1,))) * scale
         if causal:
             s = jnp.where(_block_mask(iq, jk, block_q, block_k), s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0])          # lse block is (block_q, 1)
         dp = _dot(do, vb, ((1,), (1,)))
-        ds = p * (dp - delta_ref[0][:, None])
-        dq_scr[:] = dq_scr[:] + _dot(ds, kb, ((1,), (0,)))
+        ds = p * (dp - delta_ref[0])
+        dq_scr[:] = dq_scr[:] + _dot(ds.astype(kb.dtype), kb, ((1,), (0,)))
 
     @pl.when(jk == n_kv - 1)
     def _finalize():
@@ -181,30 +191,34 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _accumulate():
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
-        qb = q_ref[0].astype(jnp.float32) * scale
-        dob = do_ref[0].astype(jnp.float32)
-        s = _dot(qb, kb, ((1,), (1,)))
+        # bf16 matmul inputs, f32 accumulation — see the fwd kernel note.
+        kb = k_ref[0]
+        vb = v_ref[0]
+        qb = q_ref[0]
+        dob = do_ref[0]
+        s = _dot(qb, kb, ((1,), (1,))) * scale
         if causal:
             s = jnp.where(_block_mask(iq, jk, block_q, block_k), s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])                # (BQ, BK)
-        dv_scr[:] = dv_scr[:] + _dot(p, dob, ((0,), (0,)))
+        p = jnp.exp(s - lse_ref[0])                         # (BQ, BK)
+        dv_scr[:] = dv_scr[:] + _dot(p.astype(dob.dtype), dob, ((0,), (0,)))
         dp = _dot(dob, vb, ((1,), (1,)))
-        ds = p * (dp - delta_ref[0][:, None])
-        # qb already carries the scale factor; dk needs none extra.
+        ds = (p * (dp - delta_ref[0])).astype(qb.dtype)
+        # ds·q is unscaled; the scale factor lands in the finalize below.
         dk_scr[:] = dk_scr[:] + _dot(ds, qb, ((0,), (0,)))
 
     @pl.when(iq == n_q - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd(block_q, block_k, scale, causal, res, do):
     q, k, v, o, lse = res
     BH, T, D = q.shape
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # (BH, T, 1) like lse — see the fwd finalize note on Mosaic block rules.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
 
     dq = pl.pallas_call(
         functools.partial(
@@ -217,8 +231,8 @@ def _bwd(block_q, block_k, scale, causal, res, do):
             pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
@@ -237,8 +251,8 @@ def _bwd(block_q, block_k, scale, causal, res, do):
             pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i)),
-            pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
@@ -281,6 +295,17 @@ def _flash_bh_bwd(block_q, block_k, causal, res, do):
 _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 
 
+def _default_block(T: int) -> int:
+    """Largest power-of-two block ≤ 512 dividing T. 512 measured fastest on
+    v5e at seq 512 (block sweep, BASELINE.md attention table): bigger blocks
+    mean fewer grid programs and larger MXU matmuls; VMEM stays comfortable
+    (the f32 score block at 512² is 1 MiB)."""
+    for b in (512, 256, 128):
+        if T % b == 0:
+            return b
+    return min(128, T)
+
+
 def flash_supported(cfg=None) -> bool:
     """Can the Pallas kernel lower (not interpret) for this model config?
 
@@ -320,8 +345,8 @@ def flash_attention(
     (``GPT2Config.__post_init__``); this op stays strict.
     """
     B, H, T, D = q.shape
-    bq = block_q or min(128, T)
-    bk = block_k or min(128, T)
+    bq = block_q or _default_block(T)
+    bk = block_k or _default_block(T)
     if T % bq or T % bk:
         raise ValueError(f"seq len {T} not divisible by blocks ({bq}, {bk})")
     qf = q.reshape(B * H, T, D)

@@ -152,7 +152,15 @@ class Model:
         self._objective = Expr.of(e)
 
     # ----------------------------------------------------------------- solve
-    def solve(self, time_limit: Optional[float] = None, gap: float = 1e-4) -> SolveResult:
+    def solve(
+        self,
+        time_limit: Optional[float] = None,
+        gap: float = 1e-4,
+        relax: bool = False,
+    ) -> SolveResult:
+        """``relax=True`` drops all integrality (the LP relaxation): the
+        optimum is then a valid lower bound on the MILP optimum — used by
+        ``milp.makespan_lower_bound`` for optimality-gap reporting."""
         n = len(self._lb)
         if self._objective is None:
             raise ValueError("no objective set")
@@ -183,7 +191,11 @@ class Model:
         )
         lc = LinearConstraint(A, np.asarray(lo), np.asarray(hi))
         bounds = Bounds(np.asarray(self._lb), np.asarray(self._ub))
-        integrality = np.asarray(self._int, dtype=np.uint8)
+        integrality = (
+            np.zeros(n, dtype=np.uint8)
+            if relax
+            else np.asarray(self._int, dtype=np.uint8)
+        )
         options: Dict[str, float] = {"mip_rel_gap": gap}
         if time_limit is not None:
             options["time_limit"] = float(time_limit)

