@@ -125,11 +125,14 @@ def main() -> None:
         params = spec.init_fn(jax.random.PRNGKey(0))
         return {"params": params, "opt_state": tx.init(params)}
 
-    def train_step(state, batch):
-        def loss_of(p):
-            return pretraining_loss(spec.apply_fn(p, batch), batch)
+    # Fused head+loss when the model provides it (ops/ce.py) — the same
+    # path the executors select for pretraining_loss tasks.
+    loss_of_params = spec.fused_loss_fn or (
+        lambda p, b: pretraining_loss(spec.apply_fn(p, b), b)
+    )
 
-        loss, grads = jax.value_and_grad(loss_of)(state["params"])
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_of_params)(state["params"], batch)
         updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         return {"params": new_params, "opt_state": new_opt}, loss

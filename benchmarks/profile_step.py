@@ -1,0 +1,136 @@
+"""Capture and summarize a jax.profiler trace of the config-#1 train step.
+
+VERDICT r2 item 1(b): name the top time sinks of the GPT-2-small b8x512 step
+on the real chip so the MFU work acts on measurements, not guesses.
+
+Runs the same step bench.py measures — model default attention ('auto' →
+flash on TPU) and the fused head+loss when the model provides one
+(``--loss logits`` forces the unfused pipeline for A/B traces) — traces a
+few steps with jax.profiler, then parses the xplane proto with xprof and
+prints the per-op rollup.
+
+Run: ``python benchmarks/profile_step.py [--attention auto|dense|flash]
+[--loss fused|logits] [--outdir /tmp/saturn_trace]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+
+def summarize_xplane(trace_dir: str, top_k: int = 25):
+    """Extract per-op self-times from the captured .xplane.pb."""
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not paths:
+        raise SystemExit(f"no xplane.pb under {trace_dir}")
+    path = max(paths, key=os.path.getmtime)
+    from xprof.convert import raw_to_tool_data as rtd
+
+    # op_profile: per-HLO-category/op rollup with fused-op attribution.
+    data, _ = rtd.xspace_to_tool_data([path], "op_profile", params={})
+    return path, json.loads(data)
+
+
+def walk_op_profile(node, depth=0, rows=None, path=()):
+    """Flatten op_profile's byProgram/byCategory tree into (name, time) rows."""
+    if rows is None:
+        rows = []
+    name = node.get("name", "?")
+    metrics = node.get("metrics") or {}
+    t = metrics.get("rawTime", 0)
+    kids = node.get("children") or []
+    if not kids and t:
+        rows.append(("/".join(path + (name,)), t, metrics))
+    for ch in kids:
+        walk_op_profile(ch, depth + 1, rows, path + (name,))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--attention", default="auto",
+                    choices=["auto", "dense", "flash"])
+    ap.add_argument("--loss", default="fused", choices=["fused", "logits"])
+    ap.add_argument("--outdir", default="/tmp/saturn_trace")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument(
+        "--parse-only", action="store_true",
+        help="skip the run; just summarize an existing trace in --outdir",
+    )
+    args = ap.parse_args()
+
+    if not args.parse_only:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from saturn_tpu.data.lm_dataset import make_lm_dataset
+        from saturn_tpu.models.gpt2 import build_gpt2
+        from saturn_tpu.models.loss import pretraining_loss
+
+        spec = build_gpt2(
+            "gpt2-small", seq_len=args.seq, attention=args.attention
+        )
+        ds = make_lm_dataset(
+            context_length=args.seq, batch_size=args.batch,
+            vocab_size=spec.config.vocab_size,
+            n_tokens=args.seq * args.batch * 8,
+        )
+        tx = optax.adamw(3e-4)
+
+        def init_state():
+            p = spec.init_fn(jax.random.PRNGKey(0))
+            return {"params": p, "opt": tx.init(p)}
+
+        if args.loss == "fused" and spec.fused_loss_fn is not None:
+            loss_of_params = spec.fused_loss_fn
+        else:
+            loss_of_params = lambda p, b: pretraining_loss(
+                spec.apply_fn(p, b), b
+            )
+
+        def train_step(state, batch):
+            loss, g = jax.value_and_grad(loss_of_params)(
+                state["params"], batch
+            )
+            up, opt = tx.update(g, state["opt"], state["params"])
+            return {"params": optax.apply_updates(state["params"], up),
+                    "opt": opt}, loss
+
+        step = jax.jit(train_step, donate_argnums=(0,))
+        state = jax.jit(init_state)()
+        batches = [jnp.asarray(ds.batch(i)) for i in range(4)]
+        for _ in range(3):  # compile + warm
+            state, loss = step(state, batches[0])
+        float(jax.device_get(loss))
+
+        os.makedirs(args.outdir, exist_ok=True)
+        with jax.profiler.trace(args.outdir):
+            for i in range(args.steps):
+                state, loss = step(state, batches[i % len(batches)])
+            float(jax.device_get(loss))
+
+    path, prof = summarize_xplane(args.outdir)
+    print(f"trace: {path}\n")
+    rows = walk_op_profile(
+        prof.get("byProgramExcludeIdle") or prof.get("byCategory") or prof
+    )
+    total = sum(t for _, t, _ in rows) or 1
+    rows.sort(key=lambda r: -r[1])
+    print(f"| % of device time | op (category/op) | FLOPS util |")
+    print(f"|---|---|---|")
+    for name, t, metrics in rows[: args.top]:
+        util = metrics.get("flops", 0)
+        print(f"| {100.0 * t / total:5.1f}% | {name} | {util:.3f} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,13 @@ class ModelSpec:
     # about it (parallel/ep.py) add ``aux_loss`` to the objective, everything
     # else uses the plain ``apply_fn``.
     apply_with_aux_fn: Optional[Callable[[Any, Any], Tuple[Any, Any]]] = None
+    # Optional: ``(params, inputs) -> loss`` computing the model's STANDARD
+    # pretraining objective end-to-end with a fused head+loss (ops/ce.py —
+    # no (B,T,V) logits tensor). Executors use it in place of
+    # ``loss_fn(apply_fn(...))`` only when the task's loss_fn declares
+    # ``supports_fused_head`` (models/loss.py), so custom losses always get
+    # the logits path.
+    fused_loss_fn: Optional[Callable[[Any, Any], Any]] = None
 
     def abstract_init(self):
         import jax

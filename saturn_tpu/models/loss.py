@@ -19,3 +19,9 @@ def pretraining_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
         shifted_logits, shifted_labels
     )
     return ce.mean()
+
+
+# Executors may compute this exact objective via a model's fused head+loss
+# (``ModelSpec.fused_loss_fn`` → ops/ce.py) instead of materializing logits.
+# A custom loss_fn won't carry this marker, so it always gets the logits path.
+pretraining_loss.supports_fused_head = True

@@ -1,0 +1,366 @@
+"""Fused linear-cross-entropy for TPU: head matmul + softmax CE, Pallas.
+
+The single largest non-matmul cost in the round-3 profiler trace of the
+config-#1 step (GPT-2-small b8x512 on v5e) was the logits pipeline: XLA
+materializes f32 logits (B,T,V) = 824 MiB for the loss, a bf16 stash for the
+backward, a separately-fused dlogits (softmax gradient) tensor, and three
+reduce/broadcast fusions over (B,T,V) — together ~10% of device time at zero
+FLOPs utilization, and the allocation that OOMs b8x2048 (BASELINE.md
+attention table). The reference hit the same wall differently: its 6B
+example shrank batch sizes until torch's unfused CE fit
+(``/root/reference/examples/wikitext103/WikiText103.py:62-71``).
+
+This op computes ``mean CE(x @ W^T, labels)`` without ever materializing f32
+logits or the softmax gradient:
+
+- **fwd** tiles (token-block x vocab-block), runs the head matmul per tile,
+  and carries the online-logsumexp recurrence (flash-attention-style, over
+  the vocab axis) plus a masked gather of the label logit in VMEM scratch.
+  The only (N, V) tensor it writes is the bf16 logits stash — which XLA's
+  own CE backward also keeps (round-3 trace: ``fusion.227``'s bf16 output),
+  so numerics match the unfused path's bwd precision.
+- **bwd** recomputes nothing: two kernels read the stash, form
+  ``ds = softmax(logits) - onehot(labels)`` in registers, and feed it
+  straight to the MXU — dx = ds @ W over vocab blocks, dW = ds^T @ x over
+  token blocks. Same three matmul passes as XLA, none of the elementwise
+  (N, V) fusions.
+
+Masked tokens use label -1 (the standard ignore index): they never match a
+vocab column, and the wrapper zeros their loss and (via the mean's cotangent)
+their gradient. The vocab axis is padded to a block multiple inside the op —
+padded columns get -1e30 logits, so they vanish from the softmax and the
+gradient; the pad is fused into the bf16 weight cast XLA performs anyway.
+
+Like ``ops/flash.py``, real lowering needs the TPU backend; interpret mode
+exists for CPU numerics tests (``tests/test_ce.py``). Off-TPU (or for token
+counts no block divides) :func:`fused_linear_cross_entropy` itself computes
+the identical objective through plain XLA ops
+(:func:`dense_linear_cross_entropy`), so callers — ``models/gpt2.py``'s
+``fused_loss_fn`` — can use it unconditionally.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _col_ids(vb, block_n, block_v):
+    """(BN, BV) int32 absolute vocab column ids for vocab block vb."""
+    return vb * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (block_n, block_v), 1
+    )
+
+
+# --------------------------------------------------------------------- fwd
+def _fwd_kernel(x_ref, w_ref, lab_ref, logits_ref, loss_ref, lse_ref,
+                m_scr, l_scr, lbl_scr, *, block_n, block_v, n_vocab, masked):
+    vb = pl.program_id(1)
+    n_v = pl.num_programs(1)
+
+    @pl.when(vb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        lbl_scr[:] = jnp.zeros_like(lbl_scr)
+
+    s = _dot(x_ref[...], w_ref[...], ((1,), (1,)))        # (BN, BV) f32
+    col = _col_ids(vb, block_n, block_v)
+    if masked:
+        # pad columns → -inf logits; the stash then carries them into the
+        # backward, where exp(-1e30 - lse) = 0 kills their gradient too
+        s = jnp.where(col < n_vocab, s, NEG_INF)
+    logits_ref[...] = s.astype(logits_ref.dtype)
+
+    lab = lab_ref[...]                                     # (BN, 1) int32
+    lbl_scr[:, 0] += jnp.sum(jnp.where(col == lab, s, 0.0), axis=1)
+
+    m_prev = m_scr[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    l_scr[:, 0] = (
+        jnp.exp(m_prev - m_new) * l_scr[:, 0]
+        + jnp.exp(s - m_new[:, None]).sum(axis=-1)
+    )
+    m_scr[:, 0] = m_new
+
+    @pl.when(vb == n_v - 1)
+    def _finalize():
+        lse = m_scr[:, 0] + jnp.log(l_scr[:, 0])
+        lse_ref[...] = lse[:, None]
+        loss_ref[...] = (lse - lbl_scr[:, 0])[:, None]
+
+
+# ---------------------------------------------------------------- bwd: dx
+def _dx_kernel(logits_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, acc_scr,
+               *, block_n, block_v):
+    vb = pl.program_id(1)
+    n_v = pl.num_programs(1)
+
+    @pl.when(vb == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    p = jnp.exp(logits_ref[...].astype(jnp.float32) - lse_ref[...])
+    col = _col_ids(vb, block_n, block_v)
+    onehot = (col == lab_ref[...]).astype(jnp.float32)
+    ds = (p - onehot) * g_ref[...]                         # (BN, BV) f32
+    acc_scr[:] = acc_scr[:] + _dot(
+        ds.astype(w_ref.dtype), w_ref[...], ((1,), (0,))
+    )
+
+    @pl.when(vb == n_v - 1)
+    def _finalize():
+        dx_ref[...] = acc_scr[:].astype(dx_ref.dtype)
+
+
+# ---------------------------------------------------------------- bwd: dW
+def _dw_kernel(logits_ref, x_ref, lab_ref, lse_ref, g_ref, dw_ref, acc_scr,
+               *, block_n, block_v):
+    vb, nb = pl.program_id(0), pl.program_id(1)
+    n_n = pl.num_programs(1)
+
+    @pl.when(nb == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    p = jnp.exp(logits_ref[...].astype(jnp.float32) - lse_ref[...])
+    col = _col_ids(vb, block_n, block_v)
+    onehot = (col == lab_ref[...]).astype(jnp.float32)
+    ds = (p - onehot) * g_ref[...]                         # (BN, BV) f32
+    acc_scr[:] = acc_scr[:] + _dot(
+        ds.astype(x_ref.dtype), x_ref[...], ((0,), (0,))
+    )
+
+    @pl.when(nb == n_n - 1)
+    def _finalize():
+        dw_ref[...] = acc_scr[:]
+
+
+# ------------------------------------------------------------- vjp plumbing
+# ``blocks`` is the static tuple (bn_fwd, bv_fwd, bn_dw, bv_dw): fwd/dx tile
+# tokens wide and vocab narrow (the f32 score block is the VMEM hog under the
+# compiler's ~16 MiB scoped-vmem limit; W re-streams once per token row),
+# while dW tiles vocab wide and tokens narrow (its accumulator spans the
+# vocab block; x re-streams once per vocab row).
+def _run_fwd(x, w_p, lab, block_n, block_v, n_vocab, interpret):
+    N, D = x.shape
+    Vp = w_p.shape[0]
+    grid = (N // block_n, Vp // block_v)
+    return pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_n=block_n, block_v=block_v, n_vocab=n_vocab,
+            masked=Vp != n_vocab,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, D), lambda nb, vb: (nb, 0)),
+            pl.BlockSpec((block_v, D), lambda nb, vb: (vb, 0)),
+            pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, block_v), lambda nb, vb: (nb, vb)),
+            pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
+            pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, Vp), jnp.bfloat16),   # logits stash
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),     # per-token loss
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),     # lse
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 1), jnp.float32),         # running max
+            pltpu.VMEM((block_n, 1), jnp.float32),         # running denom
+            pltpu.VMEM((block_n, 1), jnp.float32),         # label logit
+        ],
+        interpret=interpret,
+    )(x, w_p, lab)
+
+
+# The compute-dtype cast and the vocab pad happen INSIDE the custom_vjp
+# boundary: the primal w is f32 (the wrapper casts; a no-op for the f32
+# params of every preset), so the bwd's f32 dW matches its primal exactly —
+# no reliance on JAX's temporary cotangent-dtype exception — and the f32
+# head gradient reaches the optimizer at full precision, the same contract
+# as XLA's unfused path.
+def _padded_vocab(n_vocab, blocks):
+    big = max(blocks[1], blocks[3])
+    return ((n_vocab + big - 1) // big) * big
+
+
+def _prep_w(w, x_dtype, Vp):
+    w_p = w.astype(x_dtype)
+    if Vp != w.shape[0]:
+        w_p = jnp.pad(w_p, ((0, Vp - w.shape[0]), (0, 0)))
+    return w_p
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_ce(x, w, lab, blocks, n_vocab, interpret):
+    bn, bv, _, _ = blocks
+    w_p = _prep_w(w, x.dtype, _padded_vocab(n_vocab, blocks))
+    _, loss, _ = _run_fwd(x, w_p, lab, bn, bv, n_vocab, interpret)
+    return loss
+
+
+def _fused_ce_fwd(x, w, lab, blocks, n_vocab, interpret):
+    bn, bv, _, _ = blocks
+    w_p = _prep_w(w, x.dtype, _padded_vocab(n_vocab, blocks))
+    logits, loss, lse = _run_fwd(x, w_p, lab, bn, bv, n_vocab, interpret)
+    return loss, (x, w_p, lab, logits, lse)
+
+
+def _fused_ce_bwd(blocks, n_vocab, interpret, res, g):
+    block_n, block_v, bn_dw, bv_dw = blocks
+    x, w_p, lab, logits, lse = res
+    N, D = x.shape
+    Vp = w_p.shape[0]
+    g = g.astype(jnp.float32)
+
+    dx = pl.pallas_call(
+        functools.partial(_dx_kernel, block_n=block_n, block_v=block_v),
+        grid=(N // block_n, Vp // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, block_v), lambda nb, vb: (nb, vb)),
+            pl.BlockSpec((block_v, D), lambda nb, vb: (vb, 0)),
+            pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
+            pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
+            pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, D), lambda nb, vb: (nb, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, D), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_n, D), jnp.float32)],
+        interpret=interpret,
+    )(logits, w_p, lab, lse, g)
+
+    dw = pl.pallas_call(
+        functools.partial(_dw_kernel, block_n=bn_dw, block_v=bv_dw),
+        grid=(Vp // bv_dw, N // bn_dw),
+        in_specs=[
+            pl.BlockSpec((bn_dw, bv_dw), lambda vb, nb: (nb, vb)),
+            pl.BlockSpec((bn_dw, D), lambda vb, nb: (nb, 0)),
+            pl.BlockSpec((bn_dw, 1), lambda vb, nb: (nb, 0)),
+            pl.BlockSpec((bn_dw, 1), lambda vb, nb: (nb, 0)),
+            pl.BlockSpec((bn_dw, 1), lambda vb, nb: (nb, 0)),
+        ],
+        out_specs=pl.BlockSpec((bv_dw, D), lambda vb, nb: (vb, 0)),
+        out_shape=jax.ShapeDtypeStruct((Vp, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bv_dw, D), jnp.float32)],
+        interpret=interpret,
+    )(logits, x, lab, lse, g)
+
+    dlab = np.zeros(lab.shape, dtype=jax.dtypes.float0)
+    return dx, dw[:n_vocab], dlab
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+# ------------------------------------------------------------------ public
+def _pick_block(n: int, candidates) -> Optional[int]:
+    for b in candidates:
+        if n % b == 0:
+            return b
+    return None
+
+
+def dense_linear_cross_entropy(x, w, labels, *, ignore_index=-1):
+    """Unfused reference: same math through plain XLA ops. Used as the
+    CPU/odd-shape fallback and as the numerics oracle in tests."""
+    logits = _dot(x, w.astype(x.dtype), ((x.ndim - 1,), (1,)))
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    lbl = jnp.take_along_axis(
+        logits, jnp.maximum(labels, 0)[..., None], axis=-1
+    )[..., 0]
+    per_tok = lse - lbl
+    valid = labels != ignore_index
+    count = jnp.maximum(valid.sum(), 1)
+    return jnp.where(valid, per_tok, 0.0).sum() / count
+
+
+def fused_linear_cross_entropy(
+    x: jax.Array,
+    w: jax.Array,
+    labels: jax.Array,
+    *,
+    ignore_index: int = -1,
+    block_n: Optional[int] = None,
+    block_v: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Mean cross-entropy of ``x @ w.T`` against ``labels``, fused.
+
+    ``x``: (..., N, D) hidden states (any leading dims are flattened with N);
+    ``w``: (V, D) head weights — the tied embedding table for the LM zoo;
+    ``labels``: int32 matching x's leading dims, ``ignore_index`` masks.
+    Differentiable in x and w. The mean is over unmasked tokens.
+
+    Falls back to :func:`dense_linear_cross_entropy` when the kernel cannot
+    lower for these shapes on this backend.
+    """
+    if ignore_index >= 0:
+        raise ValueError("ignore_index must be negative (labels are matched "
+                         "against vocab columns inside the kernel)")
+    *lead, D = x.shape
+    N = int(np.prod(lead)) if lead else 1
+    V = w.shape[0]
+    # interpret=None means production: real lowering on TPU, dense fallback
+    # elsewhere. Tests pass interpret=True to exercise kernel numerics on CPU.
+    interp = False if interpret is None else interpret
+
+    # fwd/dx: wide token blocks, narrow vocab blocks; dW: the transpose.
+    # Sized so every kernel's VMEM residency (score block, accumulators,
+    # double-buffered streams) stays under the ~16 MiB scoped-vmem limit up
+    # to d_model 4096 (gptj-6b): bn*D*2B (x block) ≲ 4 MiB.
+    bn_cap = max(2 * 1024 * 1024 // max(D, 1), 128)  # 2048 @ D<=1024, 512 @ 4096
+    bn = block_n or _pick_block(
+        N, tuple(b for b in (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+                 if b <= bn_cap)
+    )
+    if (
+        bn is None
+        or N % bn != 0  # explicit block_n must tile N exactly
+        or (not interp and _use_interpret())
+    ):
+        return dense_linear_cross_entropy(
+            x.reshape(N, D), w, labels.reshape(N), ignore_index=ignore_index
+        )
+    if block_v is not None:
+        bv = bv_dw = block_v
+        bn_dw = block_n or bn
+    elif V >= 2048:
+        bv, bv_dw = 512, 1024
+        bn_dw = min(512, bn)
+    else:
+        bv = bv_dw = ((V + 127) // 128) * 128
+        bn_dw = min(512, bn)
+    if N % bn_dw != 0:  # possible only with an explicit non-power-of-2 bn
+        bn_dw = bn
+
+    x2 = x.reshape(N, D)
+    lab = labels.reshape(N, 1).astype(jnp.int32)
+
+    # f32 primal: a no-op for the zoo's f32 params; the compute-dtype cast
+    # and vocab pad live inside _fused_ce so dW's dtype matches its primal
+    per_tok = _fused_ce(
+        x2, w.astype(jnp.float32), lab, (bn, bv, bn_dw, bv_dw), V, interp
+    )[:, 0]
+    valid = lab[:, 0] != ignore_index
+    count = jnp.maximum(valid.sum(), 1)
+    return jnp.where(valid, per_tok, 0.0).sum() / count

@@ -17,10 +17,12 @@ two-kernel split — a dQ kernel gridded over (query block × kv step) and a
 dK/dV kernel gridded over (kv block × query step) — recomputing
 P = exp(S - lse) from the forward's saved logsumexp.
 
-Used by the model zoo when ``GPT2Config.attention == "flash"``; numerics are
-validated against the dense reference in interpret mode on CPU
-(``tests/test_flash.py``), and the dense path remains the default until the
-kernel is faster on the target chip (``bench.py`` decides).
+Used by the model zoo when ``GPT2Config.attention`` resolves to "flash" —
+which is the DEFAULT on TPU since the round-3 chip measurements
+(``benchmarks/attention_bench.py`` on v5e, GPT-2-small, fixed 4096 tokens:
+1.01x at seq 512, 1.42x at 1024, 1.97x at 2048, and dense OOMs first at
+b8×1024; BASELINE.md attention table). Numerics are validated against the
+dense reference in interpret mode on CPU (``tests/test_flash.py``).
 """
 
 from __future__ import annotations

@@ -67,6 +67,13 @@ class SPMDTechnique(BaseTechnique):
     # working set (active tasks' current configs) while bounding growth.
     bundle_cache_cap = 32
 
+    # Whether this technique may route standard-loss tasks through the
+    # model's fused head+loss (ops/ce.py). Techniques that shard the head
+    # weights over the vocab axis must opt out: the Pallas CE kernel has no
+    # vocab-partitioning rule, so GSPMD would all-gather the full table and
+    # an unsharded (N, V) logits stash per device.
+    fused_loss_ok = True
+
     def __init__(self) -> None:
         # Bundle cache keyed by (task, config, device block): the orchestrator
         # calls execute() every interval (reference kill-and-respawn,
@@ -157,6 +164,27 @@ class SPMDTechnique(BaseTechnique):
         ):
             forward_with_aux = spec.apply_with_aux_fn
 
+        # Fused head+loss (ops/ce.py): same objective, no (B,T,V) logits.
+        # Only when the technique runs the model's own forward, the task's
+        # loss is the standard one the fused path implements, AND the
+        # technique doesn't shard the head weights over vocab (the Pallas
+        # kernel has no vocab-partitioning rule — see ``fused_loss_ok``).
+        fused = getattr(spec, "fused_loss_fn", None)
+        if (
+            fused is not None
+            and self.fused_loss_ok
+            and forward is spec.apply_fn
+            and forward_with_aux is None
+            and getattr(loss_fn, "supports_fused_head", False)
+        ):
+
+            def loss_and_grads(params, batch):
+                return jax.value_and_grad(fused)(params, batch)
+
+            return self.step_fns_from_loss_and_grads(
+                spec.init_fn, task, loss_and_grads
+            )
+
         def loss_and_grads(params, batch):
             def loss_of(p):
                 if forward_with_aux is not None:
@@ -232,9 +260,12 @@ class SPMDTechnique(BaseTechnique):
     def _with_attention_variants(
         self, task: Any, grid: List[Dict[str, Any]]
     ) -> List[Dict[str, Any]]:
-        """Cross an autotune grid with {dense, flash} attention when the
-        Pallas kernel can lower for this task's model. Dense first per base
-        config; the trial runner keeps whichever measures faster — the
+        """Cross an autotune grid with explicit {flash, dense} attention when
+        the Pallas kernel can lower for this task's model. Both variants are
+        pinned explicitly (the model default is 'auto', so an unpinned entry
+        would duplicate the flash one on TPU); flash first — it measured
+        fastest at every seq on the chip (BASELINE.md) — but the trial runner
+        keeps whichever measures faster for THIS task: the
         empirically-selected-config premise of the whole system
         (``PerformanceEvaluator.py:101-115``)."""
         from saturn_tpu.ops.flash import flash_supported
@@ -247,8 +278,8 @@ class SPMDTechnique(BaseTechnique):
             return grid
         out: List[Dict[str, Any]] = []
         for c in grid:
-            out.append(c)
             out.append(dict(c, attention="flash"))
+            out.append(dict(c, attention="dense"))
         return out
 
     def build(

@@ -19,6 +19,10 @@ from saturn_tpu.core.strategy import Techniques
 class TensorParallel(SPMDTechnique):
     name = "tp"
     technique = Techniques.TENSOR
+    # wte is vocab-sharded over 'model' (megatron embedding): the fused CE
+    # kernel can't consume a vocab shard — keep the GSPMD logits path, which
+    # partitions the head matmul + softmax along vocab natively.
+    fused_loss_ok = False
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         tp = config.get("tp", min(n_devices, 2))

@@ -183,13 +183,20 @@ class TestAttentionAutotune:
         monkeypatch.setattr(flash, "flash_supported", lambda cfg=None: True)
         for tech in (DataParallel(), FSDP()):
             grid = tech.candidate_configs(tiny_task, 2)
+            # both variants pinned EXPLICITLY (the model default is 'auto',
+            # so an unpinned entry would duplicate flash on TPU)
             assert any(c.get("attention") == "flash" for c in grid)
-            assert any("attention" not in c for c in grid)
-            # dense precedes its flash twin per base config
+            assert any(c.get("attention") == "dense" for c in grid)
+            assert all("attention" in c for c in grid)
+            # flash precedes its dense twin per base config (chip-measured
+            # fastest; BASELINE.md attention table)
             flash_idx = min(
                 i for i, c in enumerate(grid) if c.get("attention") == "flash"
             )
-            assert flash_idx > 0
+            dense_idx = min(
+                i for i, c in enumerate(grid) if c.get("attention") == "dense"
+            )
+            assert flash_idx < dense_idx
 
     def test_grid_dense_only_off_tpu(self, tiny_task):
         from saturn_tpu.parallel.dp import DataParallel
