@@ -41,12 +41,18 @@ class ModelSpec:
     # else uses the plain ``apply_fn``.
     apply_with_aux_fn: Optional[Callable[[Any, Any], Tuple[Any, Any]]] = None
     # Optional: ``(params, inputs) -> loss`` computing the model's STANDARD
-    # pretraining objective end-to-end with a fused head+loss (ops/ce.py —
-    # no (B,T,V) logits tensor). Executors use it in place of
-    # ``loss_fn(apply_fn(...))`` only when the task's loss_fn declares
-    # ``supports_fused_head`` (models/loss.py), so custom losses always get
-    # the logits path.
+    # training objective end-to-end with a fused head+loss (ops/ce.py — no
+    # (B,T,V) logits tensor). Executors use it in place of
+    # ``loss_fn(apply_fn(...))`` only when the task's loss_fn carries a
+    # ``supports_fused_head`` tag equal to ``fused_loss_objective`` — the
+    # tag pairing guarantees the fused function computes exactly the task's
+    # loss (custom/mismatched losses always get the logits path).
     fused_loss_fn: Optional[Callable[[Any, Any], Any]] = None
+    fused_loss_objective: Optional[str] = None
+    # Optional: ``(params, inputs) -> final hidden states`` (pre-head
+    # forward) — lets wrappers (models/bert.py) build their own fused
+    # objectives on top of this model's trunk.
+    hidden_fn: Optional[Callable[[Any, Any], Any]] = None
 
     def abstract_init(self):
         import jax

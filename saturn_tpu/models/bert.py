@@ -59,6 +59,12 @@ def mlm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     return (ce * m).sum() / (m.sum() * B)
 
 
+# Fused-head tag (see models/loss.py): the MLM objective is ignore-index CE
+# over the masked positions — exactly what ops/ce.py computes when unmasked
+# positions carry label -1.
+mlm_loss.supports_fused_head = "mlm"
+
+
 def build_bert(name: str = "bert-base", **overrides) -> ModelSpec:
     """Encoder ModelSpec for ``Task(get_model=...)``; train with :func:`mlm_loss`.
 
@@ -93,10 +99,28 @@ def build_bert(name: str = "bert-base", **overrides) -> ModelSpec:
         pipe["embed"] = lambda other, tokens: inner_embed(other, mask_tokens(tokens))
         hints["pipeline"] = pipe
 
+    fused_loss_fn = None
+    if spec.hidden_fn is not None:
+        # Fused head+loss for MLM (ops/ce.py): hidden states of the MASKED
+        # input against the original tokens, unmasked positions ignored via
+        # label -1 — the same mean-over-masked objective as mlm_loss.
+        def fused_loss_fn(params, tokens):
+            from saturn_tpu.ops.ce import fused_linear_cross_entropy
+
+            x = spec.hidden_fn(params, mask_tokens(tokens))
+            labels = jnp.where(
+                _mask(tokens.shape[-1])[None, :],
+                tokens.astype(jnp.int32), -1,
+            )
+            return fused_linear_cross_entropy(x, params["wte"], labels)
+
     return ModelSpec(
         init_fn=spec.init_fn,
         apply_fn=apply_fn,
         config=cfg,
         hints=hints,
         apply_with_aux_fn=None,
+        fused_loss_fn=fused_loss_fn,
+        fused_loss_objective="mlm" if fused_loss_fn else None,
+        hidden_fn=spec.hidden_fn,
     )

@@ -392,6 +392,9 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         logits = jnp.einsum("btd,vd->btv", xn, other_params["wte"].astype(cfg.dtype))
         return logits.astype(jnp.float32)
 
+    def hidden_fn(params, tokens):
+        return module.apply({"params": params}, tokens, return_hidden=True)
+
     fused_loss_fn = None
     if cfg.causal and not cfg.moe and cfg.seq_axis is None:
         # Fused head+loss (ops/ce.py): hidden states + the tied wte go
@@ -402,7 +405,7 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         def fused_loss_fn(params, tokens):
             from saturn_tpu.ops.ce import fused_linear_cross_entropy
 
-            x = module.apply({"params": params}, tokens, return_hidden=True)
+            x = hidden_fn(params, tokens)
             labels = jnp.pad(
                 tokens[:, 1:].astype(jnp.int32), ((0, 0), (0, 1)),
                 constant_values=-1,
@@ -443,6 +446,8 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         hints=hints,
         apply_with_aux_fn=apply_with_aux_fn,
         fused_loss_fn=fused_loss_fn,
+        fused_loss_objective="causal-lm" if fused_loss_fn else None,
+        hidden_fn=hidden_fn,
     )
 
 

@@ -23,5 +23,9 @@ def pretraining_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
 
 # Executors may compute this exact objective via a model's fused head+loss
 # (``ModelSpec.fused_loss_fn`` → ops/ce.py) instead of materializing logits.
-# A custom loss_fn won't carry this marker, so it always gets the logits path.
-pretraining_loss.supports_fused_head = True
+# The marker is an objective TAG matched against ``ModelSpec.
+# fused_loss_objective`` — the fused path only engages when the model's
+# fused function implements exactly this loss (a custom loss_fn carries no
+# tag and always gets the logits path; a mismatched pairing, e.g. a BERT
+# spec driven with pretraining_loss, falls back too).
+pretraining_loss.supports_fused_head = "causal-lm"

@@ -345,7 +345,10 @@ def fused_linear_cross_entropy(
         bv = bv_dw = block_v
         bn_dw = block_n or bn
     elif V >= 2048:
-        bv, bv_dw = 512, 1024
+        # dW's (bv_dw, D) f32 accumulator is its VMEM hog — keep it ≤ 4 MiB
+        # (1024 @ D<=1024, 256 @ D=4096)
+        bv = 512
+        bv_dw = max(128, min(1024, (1 << 20) // max(D, 1024)))
         bn_dw = min(512, bn)
     else:
         bv = bv_dw = ((V + 127) // 128) * 128

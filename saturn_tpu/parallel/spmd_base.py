@@ -142,10 +142,11 @@ class SPMDTechnique(BaseTechnique):
         techniques that only change the forward pass (offload streaming)
         override via ``step_fns_from_forward``.
         """
-        return self.step_fns_from_forward(spec, task, spec.apply_fn)
+        return self.step_fns_from_forward(spec, task, spec.apply_fn, mesh=mesh)
 
     def step_fns_from_forward(
-        self, spec: Any, task: Any, forward: Any, forward_with_aux: Any = None
+        self, spec: Any, task: Any, forward: Any, forward_with_aux: Any = None,
+        mesh: Any = None,
     ) -> Tuple[Any, Any]:
         """Standard loss/grad/optax scaffold around ``forward(params, batch)``.
 
@@ -166,16 +167,24 @@ class SPMDTechnique(BaseTechnique):
 
         # Fused head+loss (ops/ce.py): same objective, no (B,T,V) logits.
         # Only when the technique runs the model's own forward, the task's
-        # loss is the standard one the fused path implements, AND the
-        # technique doesn't shard the head weights over vocab (the Pallas
-        # kernel has no vocab-partitioning rule — see ``fused_loss_ok``).
+        # loss is the standard one the fused path implements, AND the block
+        # is a SINGLE device (mesh absent or size 1): a pallas_call under
+        # GSPMD has no partitioning rule, so on a multi-chip mesh the
+        # sharded batch/params would be all-gathered around it — worse than
+        # the logits path it replaces. Multi-chip blocks keep the GSPMD
+        # logits pipeline, which partitions the head matmul + softmax
+        # natively along both batch and (for TP's vocab-sharded wte,
+        # ``fused_loss_ok=False``) vocab.
         fused = getattr(spec, "fused_loss_fn", None)
+        tag = getattr(loss_fn, "supports_fused_head", None)
         if (
             fused is not None
             and self.fused_loss_ok
+            and (mesh is None or getattr(mesh, "size", 1) <= 1)
             and forward is spec.apply_fn
             and forward_with_aux is None
-            and getattr(loss_fn, "supports_fused_head", False)
+            and tag is not None
+            and tag == getattr(spec, "fused_loss_objective", None)
         ):
 
             def loss_and_grads(params, batch):
