@@ -75,6 +75,12 @@ class GPT2Config:
     # parallel attention paths assume causal, so seq techniques are only
     # feasible for causal configs.
     causal: bool = True
+    # lax.scan unroll factor for the layer stack. The round-3 profiler trace
+    # showed the scan's dynamic-update-slice activation stashing dragging
+    # the MLP matmul fusions to ~0.4-0.5 efficiency; unrolling lets XLA
+    # address the stash statically. 1 = plain scan (smallest compile);
+    # measure before changing the default (benchmarks/profile_step.py).
+    scan_unroll: int = 1
     name: str = "gpt2-small"
 
     def __post_init__(self) -> None:
@@ -341,6 +347,7 @@ class GPT2(nn.Module):
             split_rngs={"params": True},
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
+            unroll=cfg.scan_unroll,
         )
         x, _ = stack(cfg, name="blocks")(x, None)
 

@@ -158,3 +158,24 @@ class TestGPTJ:
 
     def test_trains(self, gptj_spec):
         check_trains(gptj_spec)
+
+
+def test_scan_unroll_matches_plain_scan():
+    """unroll is a scheduling knob: same params tree, same outputs up to
+    bf16 fusion-order rounding (~1 ulp — unrolling reorders XLA fusions)."""
+    import jax
+    import numpy as np
+
+    from saturn_tpu.models.gpt2 import build_gpt2
+
+    s1 = build_gpt2("test-tiny", scan_unroll=1)
+    s2 = build_gpt2("test-tiny", scan_unroll=2)
+    p = s1.init_fn(jax.random.PRNGKey(0))
+    p2 = s2.init_fn(jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    toks = s1.config.example_inputs(2)
+    np.testing.assert_allclose(
+        np.asarray(s1.apply_fn(p, toks)), np.asarray(s2.apply_fn(p, toks)),
+        rtol=2e-2, atol=1e-2,
+    )
