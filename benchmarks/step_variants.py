@@ -11,7 +11,8 @@ Timing protocol matches bench.py: donated state, compile+warmup excluded,
 queued steps with ONE host sync (the tunneled TPU adds ~70ms round-trip per
 sync, so per-call block_until_ready would swamp the signal).
 
-Run: ``python benchmarks/step_variants.py [--variants a b c ...]``
+Run: ``python benchmarks/step_variants.py [--attentions flash dense]
+[--losses fused logits] [--unrolls 1 4 12]``
 Prints a markdown table for BASELINE.md; flags the fastest variant.
 """
 

@@ -48,6 +48,10 @@ class ModelSpec:
     # tag pairing guarantees the fused function computes exactly the task's
     # loss (custom/mismatched losses always get the logits path).
     fused_loss_fn: Optional[Callable[[Any, Any], Any]] = None
+    # Same objective as ``(loss_sum, valid_count)`` — for sharded execution
+    # (the data-parallel shard_map wrapper psums both parts globally before
+    # dividing; per-shard means would misweight uneven mask counts).
+    fused_loss_parts_fn: Optional[Callable[[Any, Any], Any]] = None
     fused_loss_objective: Optional[str] = None
     # Optional: ``(params, inputs) -> final hidden states`` (pre-head
     # forward) — lets wrappers (models/bert.py) build their own fused

@@ -99,12 +99,12 @@ def build_bert(name: str = "bert-base", **overrides) -> ModelSpec:
         pipe["embed"] = lambda other, tokens: inner_embed(other, mask_tokens(tokens))
         hints["pipeline"] = pipe
 
-    fused_loss_fn = None
+    fused_loss_fn = fused_loss_parts_fn = None
     if spec.hidden_fn is not None:
         # Fused head+loss for MLM (ops/ce.py): hidden states of the MASKED
         # input against the original tokens, unmasked positions ignored via
         # label -1 — the same mean-over-masked objective as mlm_loss.
-        def fused_loss_fn(params, tokens):
+        def _fused(params, tokens, reduction):
             from saturn_tpu.ops.ce import fused_linear_cross_entropy
 
             x = spec.hidden_fn(params, mask_tokens(tokens))
@@ -112,7 +112,15 @@ def build_bert(name: str = "bert-base", **overrides) -> ModelSpec:
                 _mask(tokens.shape[-1])[None, :],
                 tokens.astype(jnp.int32), -1,
             )
-            return fused_linear_cross_entropy(x, params["wte"], labels)
+            return fused_linear_cross_entropy(
+                x, params["wte"], labels, reduction=reduction
+            )
+
+        def fused_loss_fn(params, tokens):
+            return _fused(params, tokens, "mean")
+
+        def fused_loss_parts_fn(params, tokens):
+            return _fused(params, tokens, "sum_count")
 
     return ModelSpec(
         init_fn=spec.init_fn,
@@ -121,6 +129,7 @@ def build_bert(name: str = "bert-base", **overrides) -> ModelSpec:
         hints=hints,
         apply_with_aux_fn=None,
         fused_loss_fn=fused_loss_fn,
+        fused_loss_parts_fn=fused_loss_parts_fn,
         fused_loss_objective="mlm" if fused_loss_fn else None,
         hidden_fn=spec.hidden_fn,
     )

@@ -402,14 +402,14 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
     def hidden_fn(params, tokens):
         return module.apply({"params": params}, tokens, return_hidden=True)
 
-    fused_loss_fn = None
+    fused_loss_fn = fused_loss_parts_fn = None
     if cfg.causal and not cfg.moe and cfg.seq_axis is None:
         # Fused head+loss (ops/ce.py): hidden states + the tied wte go
         # straight into the Pallas CE kernel — no (B,T,V) logits tensor.
         # Identical objective to pretraining_loss∘apply_fn (next-token CE,
         # mean over B*(T-1) real targets); the op itself falls back to a
         # dense computation off-TPU, so this is always safe to call.
-        def fused_loss_fn(params, tokens):
+        def _fused(params, tokens, reduction):
             from saturn_tpu.ops.ce import fused_linear_cross_entropy
 
             x = hidden_fn(params, tokens)
@@ -417,7 +417,17 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
                 tokens[:, 1:].astype(jnp.int32), ((0, 0), (0, 1)),
                 constant_values=-1,
             )
-            return fused_linear_cross_entropy(x, params["wte"], labels)
+            return fused_linear_cross_entropy(
+                x, params["wte"], labels, reduction=reduction
+            )
+
+        def fused_loss_fn(params, tokens):
+            return _fused(params, tokens, "mean")
+
+        def fused_loss_parts_fn(params, tokens):
+            # (loss_sum, valid_count) for sharded callers (the dp shard_map
+            # wrapper psums both parts before dividing)
+            return _fused(params, tokens, "sum_count")
 
     apply_with_aux_fn = None
     if cfg.moe:
@@ -453,6 +463,7 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         hints=hints,
         apply_with_aux_fn=apply_with_aux_fn,
         fused_loss_fn=fused_loss_fn,
+        fused_loss_parts_fn=fused_loss_parts_fn,
         fused_loss_objective="causal-lm" if fused_loss_fn else None,
         hidden_fn=hidden_fn,
     )

@@ -42,7 +42,7 @@ the identical objective through plain XLA ops
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -280,16 +280,24 @@ def _pick_block(n: int, candidates) -> Optional[int]:
     return None
 
 
+def _dense_per_token(x2, w, labels1):
+    """Per-token CE through plain XLA ops — the one implementation behind
+    both the test oracle and the production odd-shape/CPU fallback."""
+    logits = _dot(x2, w.astype(x2.dtype), ((1,), (1,)))
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    lbl = jnp.take_along_axis(
+        logits, jnp.maximum(labels1, 0)[:, None], axis=-1
+    )[:, 0]
+    return lse - lbl
+
+
 def dense_linear_cross_entropy(x, w, labels, *, ignore_index=-1):
     """Unfused reference: same math through plain XLA ops. Used as the
     CPU/odd-shape fallback and as the numerics oracle in tests."""
-    logits = _dot(x, w.astype(x.dtype), ((x.ndim - 1,), (1,)))
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    lbl = jnp.take_along_axis(
-        logits, jnp.maximum(labels, 0)[..., None], axis=-1
-    )[..., 0]
-    per_tok = lse - lbl
-    valid = labels != ignore_index
+    *lead, D = x.shape
+    N = int(np.prod(lead)) if lead else 1
+    per_tok = _dense_per_token(x.reshape(N, D), w, labels.reshape(N))
+    valid = labels.reshape(N) != ignore_index
     count = jnp.maximum(valid.sum(), 1)
     return jnp.where(valid, per_tok, 0.0).sum() / count
 
@@ -303,26 +311,42 @@ def fused_linear_cross_entropy(
     block_n: Optional[int] = None,
     block_v: Optional[int] = None,
     interpret: Optional[bool] = None,
-) -> jax.Array:
-    """Mean cross-entropy of ``x @ w.T`` against ``labels``, fused.
+    reduction: str = "mean",
+) -> Any:
+    """Cross-entropy of ``x @ w.T`` against ``labels``, fused.
 
     ``x``: (..., N, D) hidden states (any leading dims are flattened with N);
     ``w``: (V, D) head weights — the tied embedding table for the LM zoo;
     ``labels``: int32 matching x's leading dims, ``ignore_index`` masks.
-    Differentiable in x and w. The mean is over unmasked tokens.
+    Differentiable in x and w.
 
-    Falls back to :func:`dense_linear_cross_entropy` when the kernel cannot
-    lower for these shapes on this backend.
+    ``reduction="mean"`` (default) returns the mean over unmasked tokens;
+    ``"sum_count"`` returns ``(loss_sum, valid_count)`` so a sharded caller
+    (the data-parallel shard_map wrapper, ``parallel/spmd_base.py``) can
+    psum both parts and divide globally — per-shard means would weight
+    shards with different mask counts incorrectly.
+
+    Falls back to :func:`dense_linear_cross_entropy` math when the kernel
+    cannot lower for these shapes on this backend.
     """
     if ignore_index >= 0:
         raise ValueError("ignore_index must be negative (labels are matched "
                          "against vocab columns inside the kernel)")
+    if reduction not in ("mean", "sum_count"):
+        raise ValueError(f"unknown reduction {reduction!r}")
     *lead, D = x.shape
     N = int(np.prod(lead)) if lead else 1
     V = w.shape[0]
     # interpret=None means production: real lowering on TPU, dense fallback
     # elsewhere. Tests pass interpret=True to exercise kernel numerics on CPU.
     interp = False if interpret is None else interpret
+
+    def reduce(per_tok, valid):
+        count = valid.sum()
+        total = jnp.where(valid, per_tok, 0.0).sum()
+        if reduction == "sum_count":
+            return total, count
+        return total / jnp.maximum(count, 1)
 
     # fwd/dx: wide token blocks, narrow vocab blocks; dW: the transpose.
     # Sized so every kernel's VMEM residency (score block, accumulators,
@@ -338,9 +362,9 @@ def fused_linear_cross_entropy(
         or N % bn != 0  # explicit block_n must tile N exactly
         or (not interp and _use_interpret())
     ):
-        return dense_linear_cross_entropy(
-            x.reshape(N, D), w, labels.reshape(N), ignore_index=ignore_index
-        )
+        lab1 = labels.reshape(N)
+        per_tok = _dense_per_token(x.reshape(N, D), w, lab1)
+        return reduce(per_tok, lab1 != ignore_index)
     if block_v is not None:
         bv = bv_dw = block_v
         bn_dw = block_n or bn
@@ -364,6 +388,4 @@ def fused_linear_cross_entropy(
     per_tok = _fused_ce(
         x2, w.astype(jnp.float32), lab, (bn, bv, bn_dw, bv_dw), V, interp
     )[:, 0]
-    valid = lab[:, 0] != ignore_index
-    count = jnp.maximum(valid.sum(), 1)
-    return jnp.where(valid, per_tok, 0.0).sum() / count
+    return reduce(per_tok, lab[:, 0] != ignore_index)

@@ -19,6 +19,10 @@ from saturn_tpu.core.strategy import Techniques
 class DataParallel(SPMDTechnique):
     name = "dp"
     technique = Techniques.DP
+    # Params replicated + batch sharded over 'data': the fused head+loss
+    # runs on multi-chip blocks too, via the shard_map sum/count wrapper
+    # (spmd_base.step_fns_from_forward).
+    fused_loss_shardable = True
 
     def mesh_spec(self, n_devices, task, config) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
         return ("data",), (n_devices,)

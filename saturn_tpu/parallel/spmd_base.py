@@ -73,6 +73,11 @@ class SPMDTechnique(BaseTechnique):
     # vocab-partitioning rule, so GSPMD would all-gather the full table and
     # an unsharded (N, V) logits stash per device.
     fused_loss_ok = True
+    # Whether the fused loss may run on MULTI-chip blocks via the shard_map
+    # wrapper (step_fns_from_forward): only valid for purely batch-sharding
+    # techniques — params must be replicated (in_spec P()) and the batch
+    # sharded along the mesh. dp opts in; fsdp/tp shard params.
+    fused_loss_shardable = False
 
     def __init__(self) -> None:
         # Bundle cache keyed by (task, config, device block): the orchestrator
@@ -142,11 +147,14 @@ class SPMDTechnique(BaseTechnique):
         techniques that only change the forward pass (offload streaming)
         override via ``step_fns_from_forward``.
         """
-        return self.step_fns_from_forward(spec, task, spec.apply_fn, mesh=mesh)
+        return self.step_fns_from_forward(
+            spec, task, spec.apply_fn, mesh=mesh,
+            batch_partition=self.batch_spec(config),
+        )
 
     def step_fns_from_forward(
         self, spec: Any, task: Any, forward: Any, forward_with_aux: Any = None,
-        mesh: Any = None,
+        mesh: Any = None, batch_partition: Any = None,
     ) -> Tuple[Any, Any]:
         """Standard loss/grad/optax scaffold around ``forward(params, batch)``.
 
@@ -166,29 +174,56 @@ class SPMDTechnique(BaseTechnique):
             forward_with_aux = spec.apply_with_aux_fn
 
         # Fused head+loss (ops/ce.py): same objective, no (B,T,V) logits.
-        # Only when the technique runs the model's own forward, the task's
-        # loss is the standard one the fused path implements, AND the block
-        # is a SINGLE device (mesh absent or size 1): a pallas_call under
-        # GSPMD has no partitioning rule, so on a multi-chip mesh the
-        # sharded batch/params would be all-gathered around it — worse than
-        # the logits path it replaces. Multi-chip blocks keep the GSPMD
-        # logits pipeline, which partitions the head matmul + softmax
-        # natively along both batch and (for TP's vocab-sharded wte,
-        # ``fused_loss_ok=False``) vocab.
+        # Only when the technique runs the model's own forward and the
+        # task's loss is the standard one the fused path implements. A
+        # pallas_call has NO GSPMD partitioning rule, so how it engages
+        # depends on the block:
+        # - single device (mesh absent or size 1): call it directly;
+        # - multi-chip blocks of a purely batch-sharding technique
+        #   (``fused_loss_shardable``, i.e. dp: params replicated): wrap it
+        #   in shard_map — each device runs the kernel on its batch shard
+        #   and the (loss_sum, valid_count) parts are psum'd before the
+        #   global divide (per-shard means would misweight uneven masks);
+        # - everything else (fsdp's vocab-sharded wte, tp) keeps the GSPMD
+        #   logits pipeline, which partitions the head matmul + softmax
+        #   natively.
         fused = getattr(spec, "fused_loss_fn", None)
+        parts = getattr(spec, "fused_loss_parts_fn", None)
         tag = getattr(loss_fn, "supports_fused_head", None)
+        single = mesh is None or getattr(mesh, "size", 1) <= 1
         if (
             fused is not None
             and self.fused_loss_ok
-            and (mesh is None or getattr(mesh, "size", 1) <= 1)
+            and (single or (self.fused_loss_shardable and parts is not None))
             and forward is spec.apply_fn
             and forward_with_aux is None
             and tag is not None
             and tag == getattr(spec, "fused_loss_objective", None)
         ):
+            if single:
+                fused_loss = fused
+            else:
+                from jax import shard_map
+
+                axes = tuple(mesh.axis_names)
+                bspec = batch_partition if batch_partition is not None else P(
+                    axes[0]
+                )
+
+                def _local(p, b):
+                    s, c = parts(p, b)
+                    s = jax.lax.psum(s, axes)
+                    c = jax.lax.psum(c, axes)
+                    return s / jax.numpy.maximum(c, 1)
+
+                def fused_loss(params, batch):
+                    return shard_map(
+                        _local, mesh=mesh, in_specs=(P(), bspec),
+                        out_specs=P(),
+                    )(params, batch)
 
             def loss_and_grads(params, batch):
-                return jax.value_and_grad(fused)(params, batch)
+                return jax.value_and_grad(fused_loss)(params, batch)
 
             return self.step_fns_from_loss_and_grads(
                 spec.init_fn, task, loss_and_grads

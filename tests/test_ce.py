@@ -212,26 +212,28 @@ class TestModelFusedLoss:
         assert pretraining_loss.supports_fused_head == "causal-lm"
         assert spec.fused_loss_objective == "mlm"
 
-    def test_multi_device_mesh_keeps_logits_path(self):
-        """A >1-device mesh must not route through the fused kernel — a
-        pallas_call has no GSPMD partitioning rule, so the sharded batch
-        would be all-gathered around it (round-3 review finding)."""
+    @staticmethod
+    def _mesh_gate_case(technique, mesh_devices):
         from jax.sharding import Mesh
         from saturn_tpu.core.task import HParams, Task
         from saturn_tpu.data.lm_dataset import make_lm_dataset
         from saturn_tpu.models.gpt2 import build_gpt2
         from saturn_tpu.models.loss import pretraining_loss
-        from saturn_tpu.parallel.dp import DataParallel
 
-        calls = {"fused": 0}
+        calls = {"fused": 0, "parts": 0}
         spec = build_gpt2("test-tiny")
-        orig = spec.fused_loss_fn
+        orig, orig_parts = spec.fused_loss_fn, spec.fused_loss_parts_fn
 
         def counting_fused(params, tokens):
             calls["fused"] += 1
             return orig(params, tokens)
 
+        def counting_parts(params, tokens):
+            calls["parts"] += 1
+            return orig_parts(params, tokens)
+
         spec.fused_loss_fn = counting_fused
+        spec.fused_loss_parts_fn = counting_parts
         task = Task(
             get_model=lambda **kw: spec,
             get_dataloader=lambda: make_lm_dataset(
@@ -242,8 +244,10 @@ class TestModelFusedLoss:
             hparams=HParams(lr=1e-3, batch_count=2),
             name="fused-mesh-gate",
         )
-        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("data",))
-        init_state, train_step = DataParallel().make_step_fns(
+        mesh = Mesh(
+            np.array(mesh_devices).reshape(len(mesh_devices)), ("data",)
+        )
+        init_state, train_step = technique.make_step_fns(
             spec, task, {"remat": False}, mesh, task.get_dataset()
         )
         params = spec.init_fn(jax.random.PRNGKey(0))
@@ -253,4 +257,49 @@ class TestModelFusedLoss:
                                      "step": jnp.zeros((), jnp.int32)}, b),
             params, jnp.zeros((2, 64), jnp.int32),
         )
-        assert calls["fused"] == 0
+        return calls
+
+    def test_multi_device_fsdp_keeps_logits_path(self):
+        """fsdp shards params (incl. the vocab-dim wte), so multi-chip
+        blocks must not route through the fused kernel — a pallas_call has
+        no GSPMD partitioning rule (round-3 review finding)."""
+        from saturn_tpu.parallel.fsdp import FSDP
+
+        calls = self._mesh_gate_case(FSDP(), jax.devices()[:2])
+        assert calls == {"fused": 0, "parts": 0}
+
+    def test_multi_device_dp_routes_fused_parts(self):
+        """dp (replicated params, batch-sharded) runs the fused loss on
+        multi-chip blocks through the shard_map sum/count wrapper."""
+        from saturn_tpu.parallel.dp import DataParallel
+
+        calls = self._mesh_gate_case(DataParallel(), jax.devices()[:2])
+        assert calls["parts"] >= 1 and calls["fused"] == 0
+
+    def test_dp_sharded_fused_loss_matches_unsharded(self):
+        """The psum'd (sum, count) mean over 2 batch shards equals the
+        single-program fused mean."""
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from saturn_tpu.models.gpt2 import build_gpt2
+
+        spec = build_gpt2("test-tiny")
+        params = spec.init_fn(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, spec.config.seq_len), 0,
+            spec.config.vocab_size,
+        ).astype(jnp.int32)
+        ref = spec.fused_loss_fn(params, tokens)
+
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("data",))
+
+        def local(p, b):
+            s, c = spec.fused_loss_parts_fn(p, b)
+            return (jax.lax.psum(s, ("data",))
+                    / jnp.maximum(jax.lax.psum(c, ("data",)), 1))
+
+        got = shard_map(
+            local, mesh=mesh, in_specs=(P(), P("data")), out_specs=P()
+        )(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5)
