@@ -16,14 +16,17 @@ logits or the softmax gradient:
 - **fwd** tiles (token-block x vocab-block), runs the head matmul per tile,
   and carries the online-logsumexp recurrence (flash-attention-style, over
   the vocab axis) plus a masked gather of the label logit in VMEM scratch.
-  The only (N, V) tensor it writes is the bf16 logits stash — which XLA's
-  own CE backward also keeps (round-3 trace: ``fusion.227``'s bf16 output),
-  so numerics match the unfused path's bwd precision.
-- **bwd** recomputes nothing: two kernels read the stash, form
-  ``ds = softmax(logits) - onehot(labels)`` in registers, and feed it
-  straight to the MXU — dx = ds @ W over vocab blocks, dW = ds^T @ x over
-  token blocks. Same three matmul passes as XLA, none of the elementwise
-  (N, V) fusions.
+  In stash mode it also writes ONE (N, V) tensor — a bf16 logits stash for
+  the backward, the same thing XLA's own CE backward keeps (round-3 trace:
+  ``fusion.227``'s bf16 output); in recompute mode it writes no (N, V)
+  tensor at all.
+- **bwd** forms ``ds = softmax(logits) - onehot(labels)`` in registers and
+  feeds it straight to the MXU — dx = ds @ W over vocab blocks, dW =
+  ds^T @ x over token blocks. Two source modes (``stash`` arg): read the
+  fwd's bf16 logits stash (same three matmul passes as XLA, none of the
+  elementwise (N, V) fusions), or — long-context mode — recompute each
+  score block from x·W^T in-kernel, which costs one extra matmul pass per
+  backward kernel and needs ZERO O(N·V) memory.
 
 Masked tokens use label -1 (the standard ignore index): they never match a
 vocab column, and the wrapper zeros their loss and (via the mean's cotangent)
@@ -70,8 +73,12 @@ def _col_ids(vb, block_n, block_v):
 
 
 # --------------------------------------------------------------------- fwd
-def _fwd_kernel(x_ref, w_ref, lab_ref, logits_ref, loss_ref, lse_ref,
-                m_scr, l_scr, lbl_scr, *, block_n, block_v, n_vocab, masked):
+def _fwd_kernel(x_ref, w_ref, lab_ref, *refs,
+                block_n, block_v, n_vocab, masked, stash):
+    if stash:
+        logits_ref, loss_ref, lse_ref, m_scr, l_scr, lbl_scr = refs
+    else:
+        loss_ref, lse_ref, m_scr, l_scr, lbl_scr = refs
     vb = pl.program_id(1)
     n_v = pl.num_programs(1)
 
@@ -84,10 +91,12 @@ def _fwd_kernel(x_ref, w_ref, lab_ref, logits_ref, loss_ref, lse_ref,
     s = _dot(x_ref[...], w_ref[...], ((1,), (1,)))        # (BN, BV) f32
     col = _col_ids(vb, block_n, block_v)
     if masked:
-        # pad columns → -inf logits; the stash then carries them into the
-        # backward, where exp(-1e30 - lse) = 0 kills their gradient too
+        # pad columns → -inf logits; the stash (or the bwd recompute, which
+        # applies the same mask) carries them into the backward, where
+        # exp(-1e30 - lse) = 0 kills their gradient too
         s = jnp.where(col < n_vocab, s, NEG_INF)
-    logits_ref[...] = s.astype(logits_ref.dtype)
+    if stash:
+        logits_ref[...] = s.astype(logits_ref.dtype)
 
     lab = lab_ref[...]                                     # (BN, 1) int32
     lbl_scr[:, 0] += jnp.sum(jnp.where(col == lab, s, 0.0), axis=1)
@@ -107,9 +116,25 @@ def _fwd_kernel(x_ref, w_ref, lab_ref, logits_ref, loss_ref, lse_ref,
         loss_ref[...] = (lse - lbl_scr[:, 0])[:, None]
 
 
+# ----------------------------------------------------------- bwd: shared ds
+def _ds_block(s_f32, vb, lab_ref, lse_ref, g_ref, block_n, block_v):
+    """softmax(logits) - onehot(labels), scaled by the upstream cotangent."""
+    p = jnp.exp(s_f32 - lse_ref[...])
+    col = _col_ids(vb, block_n, block_v)
+    onehot = (col == lab_ref[...]).astype(jnp.float32)
+    return (p - onehot) * g_ref[...]                       # (BN, BV) f32
+
+
+def _recomputed_s(x_ref, w_ref, vb, block_n, block_v, n_vocab, masked):
+    s = _dot(x_ref[...], w_ref[...], ((1,), (1,)))         # (BN, BV) f32
+    if masked:
+        s = jnp.where(_col_ids(vb, block_n, block_v) < n_vocab, s, NEG_INF)
+    return s
+
+
 # ---------------------------------------------------------------- bwd: dx
-def _dx_kernel(logits_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, acc_scr,
-               *, block_n, block_v):
+def _dx_kernel(src_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, acc_scr,
+               *, block_n, block_v, n_vocab, masked, stash):
     vb = pl.program_id(1)
     n_v = pl.num_programs(1)
 
@@ -117,10 +142,12 @@ def _dx_kernel(logits_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, acc_scr,
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    p = jnp.exp(logits_ref[...].astype(jnp.float32) - lse_ref[...])
-    col = _col_ids(vb, block_n, block_v)
-    onehot = (col == lab_ref[...]).astype(jnp.float32)
-    ds = (p - onehot) * g_ref[...]                         # (BN, BV) f32
+    if stash:  # src = bf16 logits stash block (BN, BV)
+        s = src_ref[...].astype(jnp.float32)
+    else:      # src = x block (BN, D): recompute the score block
+        s = _recomputed_s(src_ref, w_ref, vb, block_n, block_v, n_vocab,
+                          masked)
+    ds = _ds_block(s, vb, lab_ref, lse_ref, g_ref, block_n, block_v)
     acc_scr[:] = acc_scr[:] + _dot(
         ds.astype(w_ref.dtype), w_ref[...], ((1,), (0,))
     )
@@ -131,8 +158,8 @@ def _dx_kernel(logits_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, acc_scr,
 
 
 # ---------------------------------------------------------------- bwd: dW
-def _dw_kernel(logits_ref, x_ref, lab_ref, lse_ref, g_ref, dw_ref, acc_scr,
-               *, block_n, block_v):
+def _dw_kernel(src_ref, x_ref, lab_ref, lse_ref, g_ref, dw_ref, acc_scr,
+               *, block_n, block_v, n_vocab, masked, stash):
     vb, nb = pl.program_id(0), pl.program_id(1)
     n_n = pl.num_programs(1)
 
@@ -140,10 +167,12 @@ def _dw_kernel(logits_ref, x_ref, lab_ref, lse_ref, g_ref, dw_ref, acc_scr,
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    p = jnp.exp(logits_ref[...].astype(jnp.float32) - lse_ref[...])
-    col = _col_ids(vb, block_n, block_v)
-    onehot = (col == lab_ref[...]).astype(jnp.float32)
-    ds = (p - onehot) * g_ref[...]                         # (BN, BV) f32
+    if stash:  # src = bf16 logits stash block (BN, BV)
+        s = src_ref[...].astype(jnp.float32)
+    else:      # src = W block (BV, D): recompute from this kernel's x input
+        s = _recomputed_s(x_ref, src_ref, vb, block_n, block_v, n_vocab,
+                          masked)
+    ds = _ds_block(s, vb, lab_ref, lse_ref, g_ref, block_n, block_v)
     acc_scr[:] = acc_scr[:] + _dot(
         ds.astype(x_ref.dtype), x_ref[...], ((0,), (0,))
     )
@@ -159,14 +188,27 @@ def _dw_kernel(logits_ref, x_ref, lab_ref, lse_ref, g_ref, dw_ref, acc_scr,
 # compiler's ~16 MiB scoped-vmem limit; W re-streams once per token row),
 # while dW tiles vocab wide and tokens narrow (its accumulator spans the
 # vocab block; x re-streams once per vocab row).
-def _run_fwd(x, w_p, lab, block_n, block_v, n_vocab, interpret):
+def _run_fwd(x, w_p, lab, block_n, block_v, n_vocab, interpret, stash):
     N, D = x.shape
     Vp = w_p.shape[0]
     grid = (N // block_n, Vp // block_v)
-    return pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
+        pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((N, 1), jnp.float32),         # per-token loss
+        jax.ShapeDtypeStruct((N, 1), jnp.float32),         # lse
+    ]
+    if stash:
+        out_specs.insert(
+            0, pl.BlockSpec((block_n, block_v), lambda nb, vb: (nb, vb))
+        )
+        out_shape.insert(0, jax.ShapeDtypeStruct((N, Vp), jnp.bfloat16))
+    outs = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_n=block_n, block_v=block_v, n_vocab=n_vocab,
-            masked=Vp != n_vocab,
+            masked=Vp != n_vocab, stash=stash,
         ),
         grid=grid,
         in_specs=[
@@ -174,16 +216,8 @@ def _run_fwd(x, w_p, lab, block_n, block_v, n_vocab, interpret):
             pl.BlockSpec((block_v, D), lambda nb, vb: (vb, 0)),
             pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((block_n, block_v), lambda nb, vb: (nb, vb)),
-            pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
-            pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((N, Vp), jnp.bfloat16),   # logits stash
-            jax.ShapeDtypeStruct((N, 1), jnp.float32),     # per-token loss
-            jax.ShapeDtypeStruct((N, 1), jnp.float32),     # lse
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_n, 1), jnp.float32),         # running max
             pltpu.VMEM((block_n, 1), jnp.float32),         # running denom
@@ -191,6 +225,10 @@ def _run_fwd(x, w_p, lab, block_n, block_v, n_vocab, interpret):
         ],
         interpret=interpret,
     )(x, w_p, lab)
+    if stash:
+        return outs  # (logits, loss, lse)
+    loss, lse = outs
+    return None, loss, lse
 
 
 # The compute-dtype cast and the vocab pad happen INSIDE the custom_vjp
@@ -211,33 +249,48 @@ def _prep_w(w, x_dtype, Vp):
     return w_p
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fused_ce(x, w, lab, blocks, n_vocab, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused_ce(x, w, lab, blocks, n_vocab, interpret, stash):
     bn, bv, _, _ = blocks
     w_p = _prep_w(w, x.dtype, _padded_vocab(n_vocab, blocks))
-    _, loss, _ = _run_fwd(x, w_p, lab, bn, bv, n_vocab, interpret)
+    _, loss, _ = _run_fwd(x, w_p, lab, bn, bv, n_vocab, interpret,
+                          stash=False)
     return loss
 
 
-def _fused_ce_fwd(x, w, lab, blocks, n_vocab, interpret):
+def _fused_ce_fwd(x, w, lab, blocks, n_vocab, interpret, stash):
     bn, bv, _, _ = blocks
     w_p = _prep_w(w, x.dtype, _padded_vocab(n_vocab, blocks))
-    logits, loss, lse = _run_fwd(x, w_p, lab, bn, bv, n_vocab, interpret)
+    logits, loss, lse = _run_fwd(
+        x, w_p, lab, bn, bv, n_vocab, interpret, stash=stash
+    )
     return loss, (x, w_p, lab, logits, lse)
 
 
-def _fused_ce_bwd(blocks, n_vocab, interpret, res, g):
+def _fused_ce_bwd(blocks, n_vocab, interpret, stash, res, g):
     block_n, block_v, bn_dw, bv_dw = blocks
     x, w_p, lab, logits, lse = res
     N, D = x.shape
     Vp = w_p.shape[0]
+    masked = Vp != n_vocab
     g = g.astype(jnp.float32)
 
+    # stash mode reads the bf16 logits; recompute mode re-derives the score
+    # block from x·W^T inside each kernel (one extra matmul pass per kernel,
+    # zero O(N, V) memory — the long-context mode)
+    dx_src = logits if stash else x
+    dx_src_spec = (
+        pl.BlockSpec((block_n, block_v), lambda nb, vb: (nb, vb))
+        if stash else pl.BlockSpec((block_n, D), lambda nb, vb: (nb, 0))
+    )
     dx = pl.pallas_call(
-        functools.partial(_dx_kernel, block_n=block_n, block_v=block_v),
+        functools.partial(
+            _dx_kernel, block_n=block_n, block_v=block_v, n_vocab=n_vocab,
+            masked=masked, stash=stash,
+        ),
         grid=(N // block_n, Vp // block_v),
         in_specs=[
-            pl.BlockSpec((block_n, block_v), lambda nb, vb: (nb, vb)),
+            dx_src_spec,
             pl.BlockSpec((block_v, D), lambda nb, vb: (vb, 0)),
             pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
             pl.BlockSpec((block_n, 1), lambda nb, vb: (nb, 0)),
@@ -247,13 +300,21 @@ def _fused_ce_bwd(blocks, n_vocab, interpret, res, g):
         out_shape=jax.ShapeDtypeStruct((N, D), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_n, D), jnp.float32)],
         interpret=interpret,
-    )(logits, w_p, lab, lse, g)
+    )(dx_src, w_p, lab, lse, g)
 
+    dw_src = logits if stash else w_p
+    dw_src_spec = (
+        pl.BlockSpec((bn_dw, bv_dw), lambda vb, nb: (nb, vb))
+        if stash else pl.BlockSpec((bv_dw, D), lambda vb, nb: (vb, 0))
+    )
     dw = pl.pallas_call(
-        functools.partial(_dw_kernel, block_n=bn_dw, block_v=bv_dw),
+        functools.partial(
+            _dw_kernel, block_n=bn_dw, block_v=bv_dw, n_vocab=n_vocab,
+            masked=masked, stash=stash,
+        ),
         grid=(Vp // bv_dw, N // bn_dw),
         in_specs=[
-            pl.BlockSpec((bn_dw, bv_dw), lambda vb, nb: (nb, vb)),
+            dw_src_spec,
             pl.BlockSpec((bn_dw, D), lambda vb, nb: (nb, 0)),
             pl.BlockSpec((bn_dw, 1), lambda vb, nb: (nb, 0)),
             pl.BlockSpec((bn_dw, 1), lambda vb, nb: (nb, 0)),
@@ -263,7 +324,7 @@ def _fused_ce_bwd(blocks, n_vocab, interpret, res, g):
         out_shape=jax.ShapeDtypeStruct((Vp, D), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bv_dw, D), jnp.float32)],
         interpret=interpret,
-    )(logits, x, lab, lse, g)
+    )(dw_src, x, lab, lse, g)
 
     dlab = np.zeros(lab.shape, dtype=jax.dtypes.float0)
     return dx, dw[:n_vocab], dlab
@@ -302,6 +363,13 @@ def dense_linear_cross_entropy(x, w, labels, *, ignore_index=-1):
     return jnp.where(valid, per_tok, 0.0).sum() / count
 
 
+# Auto stash threshold: keep the bf16 logits stash (saves one recompute
+# matmul pass in each backward kernel) while it stays a modest slice of
+# HBM; above this, recompute mode drops ALL O(N·V) memory — the difference
+# between b8x2048 GPT-2 fitting on a v5e chip or not.
+STASH_BYTES_MAX = 512 * 1024 * 1024
+
+
 def fused_linear_cross_entropy(
     x: jax.Array,
     w: jax.Array,
@@ -312,6 +380,7 @@ def fused_linear_cross_entropy(
     block_v: Optional[int] = None,
     interpret: Optional[bool] = None,
     reduction: str = "mean",
+    stash: Optional[bool] = None,
 ) -> Any:
     """Cross-entropy of ``x @ w.T`` against ``labels``, fused.
 
@@ -325,6 +394,13 @@ def fused_linear_cross_entropy(
     (the data-parallel shard_map wrapper, ``parallel/spmd_base.py``) can
     psum both parts and divide globally — per-shard means would weight
     shards with different mask counts incorrectly.
+
+    ``stash`` picks the backward strategy: True keeps the fwd's bf16 logits
+    for the backward (fastest — XLA's own choice for the unfused path);
+    False recomputes score blocks from x·W^T in each backward kernel (one
+    extra matmul pass per kernel, ZERO O(N·V) memory — long-context mode).
+    None (default) stashes only while the stash stays under
+    ``STASH_BYTES_MAX``.
 
     Falls back to :func:`dense_linear_cross_entropy` math when the kernel
     cannot lower for these shapes on this backend.
@@ -383,9 +459,14 @@ def fused_linear_cross_entropy(
     x2 = x.reshape(N, D)
     lab = labels.reshape(N, 1).astype(jnp.int32)
 
+    if stash is None:
+        Vp = _padded_vocab(V, (bn, bv, bn_dw, bv_dw))
+        stash = N * Vp * 2 <= STASH_BYTES_MAX
+
     # f32 primal: a no-op for the zoo's f32 params; the compute-dtype cast
     # and vocab pad live inside _fused_ce so dW's dtype matches its primal
     per_tok = _fused_ce(
-        x2, w.astype(jnp.float32), lab, (bn, bv, bn_dw, bv_dw), V, interp
+        x2, w.astype(jnp.float32), lab, (bn, bv, bn_dw, bv_dw), V, interp,
+        stash,
     )[:, 0]
     return reduce(per_tok, lab[:, 0] != ignore_index)

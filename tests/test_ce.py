@@ -63,6 +63,37 @@ class TestFusedCE:
         np.testing.assert_allclose(np.asarray(got_gw), np.asarray(ref_gw),
                                    rtol=2e-2, atol=3e-4)
 
+
+    # recompute mode: no logits stash; bwd re-derives score blocks from
+    # x@W^T — the long-context memory mode must match the oracle too
+    @pytest.mark.parametrize("v", [256, 300])
+    def test_recompute_mode_matches_dense(self, v):
+        x, w, labels = _case(v=v)
+        ref = dense_linear_cross_entropy(x, w, labels)
+        got = fused_linear_cross_entropy(
+            x, w, labels, block_n=64, block_v=128, interpret=True,
+            stash=False,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-3)
+        ref_gx, ref_gw = jax.grad(
+            lambda x_, w_: dense_linear_cross_entropy(x_, w_, labels),
+            argnums=(0, 1),
+        )(x, w)
+        got_gx, got_gw = jax.grad(
+            lambda x_, w_: fused_linear_cross_entropy(
+                x_, w_, labels, block_n=64, block_v=128, interpret=True,
+                stash=False,
+            ),
+            argnums=(0, 1),
+        )(x, w)
+        # recompute keeps f32 scores in bwd (no bf16 stash), so tolerances
+        # are tighter than the stash-mode test
+        np.testing.assert_allclose(np.asarray(got_gx), np.asarray(ref_gx),
+                                   rtol=2e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_gw), np.asarray(ref_gw),
+                                   rtol=2e-3, atol=1e-5)
+
     def test_masked_tokens_zero_grad(self):
         x, w, labels = _case(masked=16)
         gx = jax.grad(
