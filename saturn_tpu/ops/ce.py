@@ -238,8 +238,12 @@ def _run_fwd(x, w_p, lab, block_n, block_v, n_vocab, interpret, stash):
 # head gradient reaches the optimizer at full precision, the same contract
 # as XLA's unfused path.
 def _padded_vocab(n_vocab, blocks):
-    big = max(blocks[1], blocks[3])
-    return ((n_vocab + big - 1) // big) * big
+    # Pad to a common multiple of BOTH vocab block sizes: the fwd/dx grids
+    # step by bv and the dW grid by bv_dw, so each must tile Vp exactly —
+    # padding to only the larger block truncates the other's grid and drops
+    # real vocab columns from the logsumexp (round-3 advisor finding).
+    mult = int(np.lcm(blocks[1], blocks[3]))
+    return ((n_vocab + mult - 1) // mult) * mult
 
 
 def _prep_w(w, x_dtype, Vp):
@@ -334,6 +338,16 @@ _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 
 
 # ------------------------------------------------------------------ public
+def _auto_bv_dw(d_model: int) -> int:
+    """dW vocab block: (bv_dw, D) f32 accumulator ≤ 4 MiB, rounded DOWN to a
+    power of two ≥ the 128-lane tile. A non-128-multiple (819 @ D=1280) both
+    breaks Mosaic tiling and, pre-fix, produced a Vp the fwd grid truncated;
+    a non-power-of-two multiple (640 @ D=1536) makes lcm(bv, bv_dw) inflate
+    the vocab pad by up to ~4% dead columns in every kernel."""
+    cap = min(1024, (1 << 20) // max(d_model, 1024))
+    return max(128, 1 << (cap.bit_length() - 1))
+
+
 def _pick_block(n: int, candidates) -> Optional[int]:
     for b in candidates:
         if n % b == 0:
@@ -424,6 +438,11 @@ def fused_linear_cross_entropy(
             return total, count
         return total / jnp.maximum(count, 1)
 
+    def dense_fallback():
+        lab1 = labels.reshape(N)
+        per_tok = _dense_per_token(x.reshape(N, D), w, lab1)
+        return reduce(per_tok, lab1 != ignore_index)
+
     # fwd/dx: wide token blocks, narrow vocab blocks; dW: the transpose.
     # Sized so every kernel's VMEM residency (score block, accumulators,
     # double-buffered streams) stays under the ~16 MiB scoped-vmem limit up
@@ -438,17 +457,13 @@ def fused_linear_cross_entropy(
         or N % bn != 0  # explicit block_n must tile N exactly
         or (not interp and _use_interpret())
     ):
-        lab1 = labels.reshape(N)
-        per_tok = _dense_per_token(x.reshape(N, D), w, lab1)
-        return reduce(per_tok, lab1 != ignore_index)
+        return dense_fallback()
     if block_v is not None:
         bv = bv_dw = block_v
         bn_dw = block_n or bn
     elif V >= 2048:
-        # dW's (bv_dw, D) f32 accumulator is its VMEM hog — keep it ≤ 4 MiB
-        # (1024 @ D<=1024, 256 @ D=4096)
         bv = 512
-        bv_dw = max(128, min(1024, (1 << 20) // max(D, 1024)))
+        bv_dw = _auto_bv_dw(D)
         bn_dw = min(512, bn)
     else:
         bv = bv_dw = ((V + 127) // 128) * 128
@@ -456,11 +471,20 @@ def fused_linear_cross_entropy(
     if N % bn_dw != 0:  # possible only with an explicit non-power-of-2 bn
         bn_dw = bn
 
+    # Real TPU lowering needs lane-aligned vocab blocks (Mosaic tiles the
+    # last dim in 128-lane units); _padded_vocab's LCM padding already makes
+    # every grid tile Vp exactly, so misalignment — possible only with an
+    # explicit non-128-multiple block_v — is the one way left to reach the
+    # kernel with a shape the chip can't lower. Route it to dense. Interpret
+    # mode (CPU numerics tests) has no such constraint.
+    if not interp and (bv % 128 != 0 or bv_dw % 128 != 0):
+        return dense_fallback()
+    Vp = _padded_vocab(V, (bn, bv, bn_dw, bv_dw))
+
     x2 = x.reshape(N, D)
     lab = labels.reshape(N, 1).astype(jnp.int32)
 
     if stash is None:
-        Vp = _padded_vocab(V, (bn, bv, bn_dw, bv_dw))
         stash = N * Vp * 2 <= STASH_BYTES_MAX
 
     # f32 primal: a no-op for the zoo's f32 params; the compute-dtype cast
