@@ -14,6 +14,15 @@ Round-1 hardening: the TPU backend can fail to init transiently
 now probed in a bounded-time subprocess with retries before the in-process
 run; on persistent failure the benchmark falls back to CPU so a parsed
 number always exists, with the degradation recorded in the JSON line.
+
+Round-4 hardening: the round-3 fallback never landed a record
+(BENCH_r03.json rc=124) because the probe burned ~380s of the driver's
+budget and the CPU fallback then attempted the FULL b8x512 workload —
+minutes of compile plus ~25s/step on the 1-core host. The probe budget is
+now ~160s worst case, and the degraded path measures a deliberately
+reduced shape (b2x256, 3 timed steps) tagged with its own shape fields and
+baseline key — a health signal that always parses, not a perf claim.
+``SATURN_BENCH_FORCE_DEGRADED=1`` skips the probe for testing.
 """
 
 from __future__ import annotations
@@ -38,7 +47,7 @@ _PEAK_TFLOPS = {
 }
 
 
-def _probe_backend(timeout_s: float = 120.0, retries: int = 2, delay_s: float = 10.0):
+def _probe_backend(timeout_s: float = 75.0, retries: int = 1, delay_s: float = 5.0):
     """Probe default-backend availability in a subprocess (bounded time).
 
     Returns the platform string on success, None after all retries fail.
@@ -90,14 +99,21 @@ def _peak_tflops(device) -> float:
 
 
 def main() -> None:
-    platform = _probe_backend()
-    degraded = False
-    if platform is None:
-        # Persistent backend failure: fall back to CPU so the round still
-        # produces a measured number; record the degradation.
-        degraded = True
+    if os.environ.get("SATURN_BENCH_FORCE_DEGRADED"):
+        platform = None
+    else:
+        platform = _probe_backend()
+    # Degraded = no accelerator: either the probe exhausted retries (wedged
+    # tunnel) or it succeeded but the default backend IS the host CPU (no
+    # TPU runtime present) — both must take the reduced workload, or the
+    # full b8x512 config times out the driver on the 1-core host.
+    degraded = platform is None or platform == "cpu"
+    if degraded:
         os.environ["JAX_PLATFORMS"] = "cpu"
-        print("bench: TPU backend unavailable after retries; CPU fallback", file=sys.stderr)
+        reason = ("unavailable after retries" if platform is None
+                  else "absent (probe returned cpu)")
+        print(f"bench: TPU backend {reason}; reduced CPU workload",
+              file=sys.stderr)
 
     import jax
 
@@ -111,7 +127,11 @@ def main() -> None:
     from saturn_tpu.models.gpt2 import build_gpt2
     from saturn_tpu.models.loss import pretraining_loss
 
-    batch_size, seq_len = 8, 512
+    # Degraded mode runs a reduced shape and step count: the full b8x512
+    # config is minutes of compile plus ~25s/step on the 1-core CI host —
+    # the reason BENCH_r03.json timed out instead of recording anything.
+    batch_size, seq_len = (2, 256) if degraded else (8, 512)
+    n_warmup, n_timed = (1, 3) if degraded else (3, 20)
     spec = build_gpt2("gpt2-small", seq_len=seq_len)
     ds = make_lm_dataset(
         context_length=seq_len,
@@ -145,11 +165,10 @@ def main() -> None:
     # compile + warmup (excluded from timing; SURVEY.md §7 "honest profiling").
     # Sync via host read of the loss: block_until_ready on the tunneled TPU
     # platform can return before queued steps drain (see utils/timing.py).
-    for _ in range(3):
+    for _ in range(n_warmup):
         state, loss = step(state, batches[0])
     float(jax.device_get(loss))
 
-    n_timed = 20
     t0 = timeit.default_timer()
     for i in range(n_timed):
         state, loss = step(state, batches[i % len(batches)])
@@ -169,6 +188,10 @@ def main() -> None:
         os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json"
     )
     key = f"gpt2s_train_tokens_per_sec_{dev.platform}"
+    if degraded:
+        # Degraded shapes get their own baseline key: a b2x256 CPU number
+        # must never update or compare against the b8x512 series.
+        key += f"_b{batch_size}x{seq_len}"
     baseline = None
     if os.path.exists(base_path):
         with open(base_path) as f:
@@ -196,7 +219,10 @@ def main() -> None:
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
     if degraded:
-        out["degraded"] = "tpu_unavailable_cpu_fallback"
+        out["degraded"] = ("tpu_unavailable_cpu_fallback" if platform is None
+                           else "no_tpu_backend_cpu")
+        out["batch_size"] = batch_size
+        out["seq_len"] = seq_len
     print(json.dumps(out))
 
 

@@ -342,7 +342,7 @@ def _auto_bv_dw(d_model: int) -> int:
     """dW vocab block: (bv_dw, D) f32 accumulator ≤ 4 MiB, rounded DOWN to a
     power of two ≥ the 128-lane tile. A non-128-multiple (819 @ D=1280) both
     breaks Mosaic tiling and, pre-fix, produced a Vp the fwd grid truncated;
-    a non-power-of-two multiple (640 @ D=1536) makes lcm(bv, bv_dw) inflate
+    a non-power-of-two 128-multiple (e.g. 640) makes lcm(bv, bv_dw) inflate
     the vocab pad by up to ~4% dead columns in every kernel."""
     cap = min(1024, (1 << 20) // max(d_model, 1024))
     return max(128, 1 << (cap.bit_length() - 1))
