@@ -148,6 +148,26 @@ def _orchestrate_loop(
                     metrics.event("solve", makespan_s=plan.makespan,
                                   n_tasks=len(remaining))
 
+                # Estimate feedback: fold each task's realized per-batch time
+                # into its executed strategy (EWMA) now that no solver thread
+                # is reading strategy state; the NEXT re-solve and forecast
+                # consume the corrected numbers. The reference only logged
+                # this error (``executor.py:126-129``).
+                for t in run_tasks:
+                    apply_fb = getattr(t, "apply_realized_feedback", None)
+                    upd = apply_fb() if apply_fb is not None else None
+                    if upd is not None:
+                        old, new = upd
+                        metrics.event(
+                            "estimate_update", task=t.name,
+                            profiled_s=round(old, 6), updated_s=round(new, 6),
+                        )
+                        if abs(new - old) > 0.25 * max(old, 1e-9):
+                            logger.info(
+                                "estimate correction for %s: %.3fs -> %.3fs "
+                                "per batch", t.name, old, new,
+                            )
+
                 if errors:  # "drop": evict failed tasks; "retry": give them
                     # max_task_retries more intervals first
                     by_name = {t.name: t for t in run_tasks}

@@ -504,6 +504,9 @@ class SPMDTechnique(BaseTechnique):
             # per-job samples/sec — the BASELINE.md per-job metric — and the
             # realized per-batch time (vs the profiled estimate forecast used)
             task.last_samples_per_sec = sps
+            # feed the profiled-vs-realized loop: the orchestrator folds this
+            # into the executed strategy after joining the overlapped solve
+            task.note_realized_per_batch(elapsed / n)
             from saturn_tpu.utils import metrics as _metrics
 
             _metrics.event(
