@@ -32,12 +32,95 @@ up front on every stage (VERDICT r1 weak item 8).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+
+
+def balance_stages(costs: Sequence[float], n_stages: int) -> Tuple[int, ...]:
+    """Contiguous layer->stage partition minimizing the max per-stage cost.
+
+    Returns per-stage layer counts (len ``n_stages``, sums to ``len(costs)``,
+    every span >= 1). The TPU-native analog of torchgpipe's
+    ``balance_by_time`` (reference ``Pipeline.py:94-103``): the reference
+    timed each layer on one GPU and block-partitioned; here the costs come
+    from the model's ``layer_costs`` hint (profiled or FLOP-derived) and the
+    exact DP replaces the reference's heuristic — L is tens, so the
+    O(S·L²) linear-partition DP is free at trace time.
+    """
+    L = len(costs)
+    S = n_stages
+    if S < 1 or S > L:
+        raise ValueError(f"cannot split {L} layers into {S} stages")
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + float(c))
+
+    def span_cost(i, j):  # layers [i, j)
+        return prefix[j] - prefix[i]
+
+    INF = (float("inf"), float("inf"))
+    # best[s][j] = lexicographic (max stage cost, max span length) splitting
+    # layers [0, j) into s stages. The secondary criterion breaks max-cost
+    # ties toward the smallest longest span: n_max sets every stage's padded
+    # param residency and scan length, so a tie spent on a longer span is
+    # pure memory/schedule waste.
+    best = [[INF] * (L + 1) for _ in range(S + 1)]
+    cut = [[0] * (L + 1) for _ in range(S + 1)]
+    best[0][0] = (0.0, 0)
+    for s in range(1, S + 1):
+        for j in range(s, L - (S - s) + 1):
+            for i in range(s - 1, j):
+                prev = best[s - 1][i]
+                cand = (max(prev[0], span_cost(i, j)), max(prev[1], j - i))
+                if cand < best[s][j]:
+                    best[s][j] = cand
+                    cut[s][j] = i
+    spans = []
+    j = L
+    for s in range(S, 0, -1):
+        i = cut[s][j]
+        spans.append(j - i)
+        j = i
+    return tuple(reversed(spans))
+
+
+def _pad_stack(blocks: Any, spans: Sequence[int], n_max: int):
+    """Repack a (L, ...) stacked layer tree into (S*n_max, ...) span-major
+    order, zero-padding each stage's span to ``n_max`` — the equal-shard
+    layout ``shard_map`` needs. Returns (padded_tree, active_mask)."""
+    bounds = [0]
+    for s in spans:
+        bounds.append(bounds[-1] + s)
+
+    def pad_leaf(a):
+        parts = []
+        for i, s in enumerate(spans):
+            seg = a[bounds[i]:bounds[i + 1]]
+            if s < n_max:
+                pad = jnp.zeros((n_max - s,) + a.shape[1:], a.dtype)
+                seg = jnp.concatenate([seg, pad], axis=0)
+            parts.append(seg)
+        return jnp.concatenate(parts, axis=0)
+
+    active = jnp.asarray(
+        [k < s for s in spans for k in range(n_max)], dtype=jnp.bool_
+    )
+    return jax.tree.map(pad_leaf, blocks), active
+
+
+def _unpad_stack(padded: Any, spans: Sequence[int], n_max: int):
+    """Inverse of :func:`_pad_stack` for the gradient tree."""
+    def unpad_leaf(a):
+        segs = [
+            a[i * n_max: i * n_max + s] for i, s in enumerate(spans)
+        ]
+        return jnp.concatenate(segs, axis=0)
+
+    return jax.tree.map(unpad_leaf, padded)
 
 
 def pipeline_loss_and_grads(
@@ -54,27 +137,71 @@ def pipeline_loss_and_grads(
     remat: bool = False,
     data_axis: str = "data",
     stage_axis: str = "stage",
+    stage_spans: Optional[Sequence[int]] = None,
 ):
     """(loss, grads) for one pipelined step over a ('data','stage') mesh.
 
-    ``params`` is the full param tree; ``params[block_key]`` must carry a
-    leading layer axis divisible by the stage count (the model-structure
-    contract the reference imposed via ``nn.Sequential`` flattening,
-    ``GPTJ.py:502-526``). ``tokens`` is the global (B, T) batch; each data
-    shard is split into ``n_microbatches`` microbatches.
+    ``params`` is the full param tree; ``params[block_key]`` carries a
+    leading layer axis (the model-structure contract the reference imposed
+    via ``nn.Sequential`` flattening, ``GPTJ.py:502-526``). ``tokens`` is
+    the global (B, T) batch; each data shard is split into
+    ``n_microbatches`` microbatches.
+
+    ``stage_spans``: per-stage layer counts for an UNEQUAL partition (from
+    :func:`balance_stages`); default is the even split, which requires the
+    layer count to divide by the stage count. Unequal spans are executed by
+    zero-padding each stage's span to the longest one and skipping padded
+    slots with ``lax.cond`` — stages still hold equal-shaped shards (the
+    ``shard_map`` contract) but run only their real layers.
     """
     S = mesh.shape[stage_axis]
     M = n_microbatches
     if M % S != 0:
         raise ValueError(f"n_microbatches {M} must be a multiple of stages {S}")
 
+    L = jax.tree.leaves(params[block_key])[0].shape[0]
+    spans = tuple(stage_spans) if stage_spans is not None else None
+    if spans is not None:
+        if len(spans) != S or sum(spans) != L or min(spans) < 1:
+            raise ValueError(
+                f"stage_spans {spans} must be {S} positive counts summing "
+                f"to {L} layers"
+            )
+        if len(set(spans)) == 1:
+            spans = None  # equal spans: take the unpadded fast path
+    if spans is None and L % S != 0:
+        raise ValueError(
+            f"{L} layers not divisible by {S} stages; pass stage_spans "
+            "(see balance_stages)"
+        )
+    n_max = max(spans) if spans is not None else L // S
+
+    active = None
+    if spans is not None:
+        padded_blocks, active = _pad_stack(params[block_key], spans, n_max)
+        params = dict(params)
+        params[block_key] = padded_blocks
+
     one_block = jax.checkpoint(block_fn) if remat else block_fn
 
-    def run_stage(local_blocks, x):
-        def body(h, layer_params):
-            return one_block(layer_params, h), None
+    def run_stage(local_blocks, active_loc, x):
+        if active_loc is None:
+            def body(h, layer_params):
+                return one_block(layer_params, h), None
 
-        y, _ = lax.scan(body, x, local_blocks)
+            y, _ = lax.scan(body, x, local_blocks)
+        else:
+            # padded slot -> identity; lax.cond (not select) so the skipped
+            # block never executes — a padded stage costs only its real span
+            def body(h, xs):
+                layer_params, act = xs
+                h2 = lax.cond(
+                    act, lambda hh: one_block(layer_params, hh),
+                    lambda hh: hh, h,
+                )
+                return h2, None
+
+            y, _ = lax.scan(body, x, (local_blocks, active_loc))
         return y
 
     block_specs = jax.tree.map(lambda _: P(stage_axis), params[block_key])
@@ -83,7 +210,7 @@ def pipeline_loss_and_grads(
         for k, v in params.items()
     }
 
-    def local_fn(p, local_tokens):
+    def local_fn(p, local_tokens, active_loc=None):
         """Runs on one (data shard, stage): local_tokens (Bd, T) int32."""
         idx = lax.axis_index(stage_axis)
         blocks = p[block_key]
@@ -121,7 +248,7 @@ def pipeline_loss_and_grads(
                     t,
                 )
                 x_in = jnp.where(idx == 0, inp0, prev)
-                y = run_stage(blocks_, x_in)
+                y = run_stage(blocks_, active_loc, x_in)
                 # Record last-stage finished microbatch t-(S-1).
                 slot = jnp.clip(t - (S - 1), 0, M - 1)
                 cur = lax.dynamic_index_in_dim(outs, slot, keepdims=False)
@@ -166,6 +293,18 @@ def pipeline_loss_and_grads(
         return loss, grads
 
     grad_specs = dict(param_specs)
+    if active is not None:
+        mapped = jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(param_specs, P(data_axis), P(stage_axis)),
+            out_specs=(P(), grad_specs),
+            check_vma=False,
+        )
+        loss, grads = mapped(params, tokens, active)
+        grads = dict(grads)
+        grads[block_key] = _unpad_stack(grads[block_key], spans, n_max)
+        return loss, grads
     mapped = jax.shard_map(
         local_fn,
         mesh=mesh,

@@ -2,11 +2,14 @@
 
 Replaces the reference's torchgpipe UDP (``examples/wikitext103/executors/
 Pipeline.py:24-167``). Reference behavior preserved: partition the layer
-stack across workers (``balance_by_time`` → here the scanned layer axis is
-sharded evenly over stages, which is exact for a homogeneous stack), and
+stack across workers (``balance_by_time``, ``Pipeline.py:94-103`` → here
+:func:`balance_stages`, an exact DP over the model's ``layer_costs`` hint
+— profiled or FLOP-derived per-layer costs, uniform when absent), and
 autotune the microbatch count (``Pipeline.py:139-159`` halving sweep → grid
 over {M} multiples of the stage count). The schedule itself lives in
-``saturn_tpu.ops.pipeline`` (shard_map + ppermute).
+``saturn_tpu.ops.pipeline`` (shard_map + ppermute); unequal stage spans
+(uneven costs, or a layer count the stage count doesn't divide) run via
+the padded-span schedule there.
 
 A ``data`` axis composes data parallelism with the pipeline: a mesh of
 ``n`` devices runs ``n/S`` pipeline replicas of ``S`` stages each.
@@ -14,13 +17,31 @@ A ``data`` axis composes data parallelism with the pipeline: a mesh of
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from jax.sharding import PartitionSpec as P
 
-from saturn_tpu.ops.pipeline import pipeline_hints, pipeline_loss_and_grads
+from saturn_tpu.ops.pipeline import (
+    balance_stages,
+    pipeline_hints,
+    pipeline_loss_and_grads,
+)
 from saturn_tpu.parallel.spmd_base import SPMDTechnique
 from saturn_tpu.core.strategy import Techniques
+
+
+def _layer_costs(spec, n_layers: int) -> Optional[list]:
+    """Per-layer cost vector from the model hints, or None for uniform.
+    Validated here so a stale hint fails search loudly, not mid-step."""
+    costs = spec.hints.get("layer_costs")
+    if costs is None:
+        return None
+    costs = list(costs)
+    if len(costs) != n_layers or min(costs) <= 0:
+        raise ValueError(
+            f"layer_costs must be {n_layers} positive entries, got {costs!r}"
+        )
+    return costs
 
 
 class Pipeline(SPMDTechnique):
@@ -42,6 +63,12 @@ class Pipeline(SPMDTechnique):
         s = config.get("stages", 2)
 
         def rules(path: str, shape: Tuple[int, ...], mesh_axes) -> P:
+            # At-rest layout: NamedSharding requires the sharded dim to
+            # divide by the axis size, so a stack the stage count doesn't
+            # divide stays replicated at rest (param memory = dp's; the
+            # padded-span repack inside the step still distributes compute).
+            # Cost-uneven stacks whose length DOES divide keep the sharded
+            # rest layout — the repack moves only boundary-crossing layers.
             if bkey in path and shape and shape[0] % s == 0:
                 return P("stage")
             return P()
@@ -55,17 +82,32 @@ class Pipeline(SPMDTechnique):
             return []
         if self._aux_incompatible(spec):
             return []  # staged forward would drop the model's aux loss
+        costs = _layer_costs(spec, n_layers)
         batch = task.get_dataset().batch_size
         grid: List[Dict[str, Any]] = []
         s = 2
-        while s <= n_devices and n_layers % s == 0 and s <= n_layers:
-            d = n_devices // s
-            # Microbatch sweep, most-microbatches (smallest bubble) first —
-            # the analog of the reference's halving search (Pipeline.py:139).
-            for m in (4 * s, 2 * s, s):
-                if batch % (d * m) == 0:
-                    grid.append({"stages": s, "microbatches": m, "remat": False})
-                    grid.append({"stages": s, "microbatches": m, "remat": True})
+        while s <= n_devices and s <= n_layers:
+            if n_devices % s == 0:
+                d = n_devices // s
+                # Balanced boundaries (reference balance_by_time analog):
+                # needed when per-layer costs are uneven OR the stage count
+                # doesn't divide the stack (pre-round-4 both cases silently
+                # produced no pp candidates).
+                spans: Optional[Tuple[int, ...]] = None
+                if costs is not None:
+                    spans = balance_stages(costs, s)
+                elif n_layers % s != 0:
+                    spans = balance_stages([1.0] * n_layers, s)
+                # Microbatch sweep, most-microbatches (smallest bubble)
+                # first — the analog of the reference's halving search
+                # (Pipeline.py:139).
+                for m in (4 * s, 2 * s, s):
+                    if batch % (d * m) == 0:
+                        base: Dict[str, Any] = {"stages": s, "microbatches": m}
+                        if spans is not None:
+                            base["spans"] = spans
+                        grid.append(dict(base, remat=False))
+                        grid.append(dict(base, remat=True))
             s <<= 1
         return grid
 
@@ -73,9 +115,13 @@ class Pipeline(SPMDTechnique):
         self._require_no_aux(spec)  # staged forward would drop an aux loss
         s = config.get("stages", 2)
         m = config.get("microbatches", 2 * s)
+        spans = config.get("spans")
         n_layers = getattr(spec.config, "n_layers", 1)
-        if n_layers % s != 0:
-            raise ValueError(f"{n_layers} layers not divisible by {s} stages")
+        if spans is None and n_layers % s != 0:
+            raise ValueError(
+                f"{n_layers} layers not divisible by {s} stages — pass "
+                "config['spans'] (candidate_configs computes balanced ones)"
+            )
         hints = pipeline_hints(spec)
         bkey = spec.hints.get("block_param_key", "blocks")
         loss_fn = task.loss_fn
@@ -92,6 +138,7 @@ class Pipeline(SPMDTechnique):
                 loss_fn=loss_fn,
                 n_microbatches=m,
                 remat=bool(config.get("remat", False)),
+                stage_spans=spans,
             )
 
         return self.step_fns_from_loss_and_grads(spec.init_fn, task, loss_and_grads)

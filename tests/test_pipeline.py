@@ -15,6 +15,11 @@ from saturn_tpu.parallel.dp import DataParallel
 from saturn_tpu.parallel.pp import Pipeline
 
 
+# Multi-device-compile-heavy on the 1-core CI host (VERDICT r3 item 7):
+# these mesh suites are the slow tier; run with -m slow (or no -m filter).
+pytestmark = pytest.mark.slow
+
+
 def test_pipeline_loss_matches_dense(tiny_task, devices8):
     pp = Pipeline()
     config = {"stages": 2, "microbatches": 2, "remat": False}
@@ -56,6 +61,137 @@ def test_pipeline_candidate_configs(tiny_task):
     for cfg in grid:
         assert cfg["microbatches"] % cfg["stages"] == 0
         assert 2 % cfg["stages"] == 0  # n_layers divisible
+
+
+def _span_maxcost(costs, spans):
+    out, i = [], 0
+    for s in spans:
+        out.append(sum(costs[i:i + s]))
+        i += s
+    return max(out)
+
+
+def test_balance_stages_beats_even_split():
+    """The DP (reference balance_by_time analog) minimizes the bottleneck
+    stage — on uneven costs its split strictly beats the even one."""
+    from saturn_tpu.ops.pipeline import balance_stages
+
+    costs = [4, 1, 1, 1, 1, 1]
+    spans = balance_stages(costs, 2)
+    assert len(spans) == 2 and sum(spans) == 6 and min(spans) >= 1
+    assert _span_maxcost(costs, spans) == 5      # [4,1 | 1,1,1,1]
+    assert _span_maxcost(costs, (3, 3)) == 6     # even split is worse
+    # Max-cost tie between (2,4) and (1,5): the tie-break must take the
+    # smaller longest span — n_max drives padded memory and scan length.
+    assert spans == (2, 4)
+
+
+def test_balance_stages_uniform_indivisible():
+    from saturn_tpu.ops.pipeline import balance_stages
+
+    spans = balance_stages([1.0] * 6, 4)
+    assert sorted(spans) == [1, 1, 2, 2]
+    with pytest.raises(ValueError):
+        balance_stages([1.0, 1.0], 3)  # more stages than layers
+
+
+def test_uneven_spans_match_dp(tmp_path, devices8):
+    """A 3-layer trunk over 2 stages (spans 2+1 via the padded schedule)
+    computes the same loss as the DP executor — the re-scheduling
+    invariant extended to unequal spans."""
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    task = Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", n_layers=3, **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256,
+            n_tokens=64 * 8 * 4,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=4),
+        save_dir=str(tmp_path / "ckpts"),
+    )
+    pp = Pipeline()
+    config = {"stages": 2, "microbatches": 2, "remat": False,
+              "spans": (2, 1)}
+    bundle = pp.build(task, devices8, config)
+    state = bundle.init()
+    batch = jax.device_put(task.get_dataset().batch(0),
+                           bundle.batch_sharding)
+    _, pp_loss = bundle.step(state, batch)
+
+    dp = DataParallel()
+    dbundle = dp.build(task, devices8, {"remat": False})
+    dstate = dbundle.init()
+    dbatch = jax.device_put(task.get_dataset().batch(0),
+                            dbundle.batch_sharding)
+    _, dp_loss = dbundle.step(dstate, dbatch)
+
+    np.testing.assert_allclose(
+        float(jax.device_get(pp_loss)), float(jax.device_get(dp_loss)),
+        rtol=2e-2,
+    )
+
+
+def test_candidate_configs_indivisible_stack(tmp_path):
+    """Pre-round-4, a layer count the stage count doesn't divide silently
+    produced zero pp candidates; now balanced spans make it feasible."""
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    task = Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", n_layers=3, **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256,
+            n_tokens=64 * 8 * 4,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=4),
+        save_dir=str(tmp_path / "ckpts"),
+    )
+    grid = Pipeline().candidate_configs(task, 8)
+    assert grid, "3-layer stack should admit pp via balanced spans"
+    for cfg in grid:
+        if cfg["stages"] == 2:
+            assert sorted(cfg["spans"]) == [1, 2]  # either order is optimal
+
+
+def test_candidate_configs_layer_costs(tmp_path):
+    """A layer_costs hint drives cost-balanced (not count-balanced)
+    boundaries, like the reference's balance_by_time."""
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    def get_model(**kw):
+        spec = build_gpt2("test-tiny", n_layers=4, **kw)
+        spec.hints["layer_costs"] = [4.0, 1.0, 1.0, 1.0]
+        return spec
+
+    task = Task(
+        get_model=get_model,
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256,
+            n_tokens=64 * 8 * 4,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=4),
+        save_dir=str(tmp_path / "ckpts"),
+    )
+    grid = Pipeline().candidate_configs(task, 8)
+    two_stage = [c for c in grid if c["stages"] == 2]
+    assert two_stage
+    costs = [4.0, 1.0, 1.0, 1.0]
+    for cfg in two_stage:
+        spans = tuple(cfg["spans"])
+        assert spans == (1, 3)  # [4 | 1,1,1] max 4 beats even [5, 2]
+        assert _span_maxcost(costs, spans) < _span_maxcost(costs, (2, 2))
 
 
 def test_pipeline_execute_and_resume(tiny_task, devices8):
