@@ -5,6 +5,8 @@ path is expected to build. Fallback behavior is tested by monkeypatching the
 loader, not by uninstalling the compiler.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -198,6 +200,48 @@ class TestNativeTokenizer:
         b, vb = word_tokenize_file(str(p), max_vocab=64, cache_dir=cache)
         np.testing.assert_array_equal(a, b)
         assert va == vb
+
+
+class TestCorpusGen:
+    """WikiText-scale corpus synthesis (data/corpus_gen.py) — small sizes
+    here; benchmarks/tokenizer_bench.py runs the 100MB+ flow."""
+
+    def test_generates_requested_size_and_type_count(self, tmp_path):
+        from saturn_tpu.data.corpus_gen import generate_corpus
+
+        out = str(tmp_path / "corpus.txt")
+        info = generate_corpus(out, size_mb=1.0, n_extra_types=5000)
+        size = os.path.getsize(out)
+        assert 0.9e6 <= size <= 1.3e6
+        assert info["bytes"] == size and info["types"] > 5000
+
+    def test_deterministic_and_idempotent(self, tmp_path):
+        from saturn_tpu.data.corpus_gen import generate_corpus
+
+        a, b = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+        generate_corpus(a, size_mb=0.2, n_extra_types=500, seed=7)
+        generate_corpus(b, size_mb=0.2, n_extra_types=500, seed=7)
+        with open(a) as fa, open(b) as fb:
+            assert fa.read() == fb.read()
+        # second call on an existing big-enough file skips regeneration
+        info = generate_corpus(a, size_mb=0.2, n_extra_types=500, seed=7)
+        assert info["tokens"] is None
+
+    def test_feeds_word_vocab_with_unk_pressure(self, tmp_path):
+        """Generated text drives a capped vocab build end to end: more
+        types than the cap -> real <unk>s, ids within range."""
+        from saturn_tpu.data.corpus_gen import generate_corpus
+        from saturn_tpu.data.lm_dataset import word_tokenize_file
+
+        out = str(tmp_path / "corpus.txt")
+        generate_corpus(out, size_mb=0.5, n_extra_types=3000)
+        ids, vocab = word_tokenize_file(
+            out, max_vocab=1024, cache_dir=str(tmp_path / "cache")
+        )
+        assert vocab == 1024
+        assert (ids == 1).any()          # <unk> pressure exists
+        assert 0 < ids.max() < 1024
+        assert len(ids) > 50_000
 
     def test_dataset_integration(self, tmp_path):
         from saturn_tpu.data.lm_dataset import make_lm_dataset
