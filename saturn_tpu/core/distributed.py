@@ -63,3 +63,33 @@ def global_topology():
     from saturn_tpu.core.mesh import SliceTopology
 
     return SliceTopology()  # groups jax.devices() by process_index
+
+
+def process_index() -> int:
+    """This process's rank; 0 on single-host runs (without importing a
+    backend when jax was never initialized by us)."""
+    import jax
+
+    try:
+        return jax.process_index()
+    except Exception:  # backend not initialized yet
+        return 0
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns host-side effects — checkpoint writes
+    (``utils/checkpoint.py``) and metrics files. Rank 0 by convention; the
+    reference had no analog because it never ran multi-host."""
+    return process_index() == 0
+
+
+def sync(name: str = "saturn_tpu_sync") -> None:
+    """Cross-process barrier (no-op single-process): lets the coordinator
+    finish a host-side effect before other processes proceed past it."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -31,14 +31,31 @@ from saturn_tpu.utils.treepath import path_str as _path_str
 log = logging.getLogger("saturn_tpu")
 
 
+def _is_coordinator() -> bool:
+    from saturn_tpu.core import distributed
+
+    return distributed.is_coordinator()
+
+
 def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
-    """Flatten a (possibly sharded, device-resident) pytree to host numpy."""
+    """Flatten a (possibly sharded, device-resident) pytree to host numpy.
+
+    Multi-host: a leaf sharded across processes is not fully addressable —
+    ``device_get`` would raise — so it is allgathered first (every process
+    pays the gather; only the coordinator writes, see ``save_async``)."""
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     out: Dict[str, np.ndarray] = {}
     for path, leaf in flat:
         key = _path_str(path)
         if key in out:
             raise ValueError(f"duplicate tree path key: {key!r}")
+        if (
+            hasattr(leaf, "is_fully_addressable")
+            and not leaf.is_fully_addressable
+        ):
+            from jax.experimental import multihost_utils
+
+            leaf = multihost_utils.process_allgather(leaf, tiled=True)
         arr = np.asarray(jax.device_get(leaf))
         # npz can't round-trip ml_dtypes (bfloat16/fp8); widen to float32 —
         # restore() narrows back to the template's dtype.
@@ -62,8 +79,11 @@ def _write_atomic(path: str, arrays: Dict[str, np.ndarray]) -> None:
 
 
 def save(path: str, tree: Any) -> None:
-    """Atomically write a pytree checkpoint to ``path`` (an ``.npz`` file)."""
-    _write_atomic(path, flatten_to_host(tree))
+    """Atomically write a pytree checkpoint to ``path`` (an ``.npz`` file).
+    Multi-host: collective gather on every rank, write on rank 0 only."""
+    arrays = flatten_to_host(tree)
+    if _is_coordinator():
+        _write_atomic(path, arrays)
 
 
 # --------------------------------------------------------------- async writes
@@ -100,9 +120,16 @@ def save_async(path: str, tree: Any) -> None:
     mid-write leaves the previous checkpoint intact (same atomicity as
     ``save``). ``flush()`` joins all outstanding writes; a failed write
     re-raises from the next join point on the same path (or ``flush``).
+
+    Multi-host: every process participates in the device->host gather (a
+    collective), but only the coordinator (rank 0) touches the filesystem —
+    N processes racing one atomic rename on shared storage would be wasted
+    I/O at best. Readers on other ranks barrier via ``distributed.sync``.
     """
     _wait_pending(path)  # at most one in-flight write per path
     arrays = flatten_to_host(tree)
+    if not _is_coordinator():
+        return
     key = os.path.abspath(path)
 
     def write():
@@ -142,8 +169,17 @@ def restore(path: str, template: Any) -> Any:
     ``template`` is a freshly-initialized train state (any technique's); leaves
     are replaced by the saved arrays with dtype preserved from the template so
     a bf16 param set restores as bf16 even though numpy stored it widened.
+
+    Multi-host: restore is a collective — every rank must call it (the
+    shared-FS contract). The barrier below runs AFTER the coordinator joins
+    its own in-flight async write, so no rank can read a half-written or
+    stale file; without it, a non-coordinator (which never has a pending
+    write to wait on) could race the coordinator's atomic rename.
     """
     _wait_pending(path)  # an async save to this path may still be in flight
+    from saturn_tpu.core import distributed
+
+    distributed.sync(f"ckpt-restore:{os.path.basename(path)}")
     with np.load(path) as data:
         saved = {k: data[k] for k in data.files}
 

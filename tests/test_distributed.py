@@ -21,6 +21,11 @@ import pytest
 from saturn_tpu.core.mesh import Block, SliceTopology
 
 
+# Multi-device-compile-heavy on the 1-core CI host (VERDICT r3 item 7):
+# these mesh suites are the slow tier; run with -m slow (or no -m filter).
+pytestmark = pytest.mark.slow
+
+
 class FakeDev:
     def __init__(self, process_index=0):
         self.process_index = process_index
@@ -154,3 +159,17 @@ class TestTwoProcessRendezvous:
         for pid, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {pid} failed:\n{out[-2000:]}"
             assert f"OK {pid}" in out
+
+
+class TestMultihostDryrun:
+    def test_train_step_and_rank0_checkpoint(self):
+        """VERDICT r3 item 9: 2 processes x 2 CPU devices — real train step
+        over the cross-process mesh, rank-0-gated checkpoint write, restore
+        on every rank. Delegates to ``__graft_entry__.dryrun_multihost`` so
+        CI and the driver exercise the same path."""
+        sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+        try:
+            import __graft_entry__ as graft
+        finally:
+            sys.path.pop(0)
+        graft.dryrun_multihost(n_processes=2, devices_per_process=2)
