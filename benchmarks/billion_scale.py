@@ -1,0 +1,130 @@
+"""Billion-parameter single-chip capability row (VERDICT r3 item 4).
+
+The reference demonstrates its spilled executor on GPT-J-6B
+(``examples/wikitext103/WikiText103.py:62-71``, ``Spilled.py:23-28``); the
+saturn_tpu analog is ``parallel/offload.py`` (pinned_host params + per-layer
+scan streaming). This script instantiates a GPT-J-class >=1B preset under
+the offload executor on ONE chip and records the BASELINE.md capability
+row: parameter count, samples/s, achieved tokens/s, and the XLA-analyzed
+vs measured HBM high-water.
+
+Each config runs in this process directly (run one config per invocation —
+``peak_bytes_in_use`` is a process-lifetime high-water mark).
+
+Run on TPU:
+  PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/billion_scale.py \
+      [--preset gptj-1b3] [--batch 4] [--seq 1024] [--steps 3]
+CPU smoke (tiny shapes, mechanism only):
+  python benchmarks/billion_scale.py --preset gptj-6b --layers 2 \
+      --batch 1 --seq 128 --steps 1 --platform cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import timeit
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="gptj-1b3")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--layers", type=int, default=None,
+                    help="override layer count (CPU smoke at real d_model)")
+    ap.add_argument("--platform", choices=["default", "cpu"], default="default")
+    ap.add_argument("--stream", type=int, default=1)
+    ap.add_argument("--remat", type=int, default=1)
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+    from saturn_tpu.parallel.offload import HostOffload
+    from saturn_tpu.utils.timing import device_hbm_bytes
+
+    overrides = {"seq_len": args.seq}
+    if args.layers is not None:
+        overrides["n_layers"] = args.layers
+
+    def get_model(**kw):
+        return build_gpt2(args.preset, **{**overrides, **kw})
+
+    spec = get_model()
+    shapes = jax.eval_shape(spec.init_fn, jax.random.PRNGKey(0))
+    n_params = sum(
+        int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes)
+    )
+    print(f"{args.preset}: {n_params/1e9:.2f}B params, "
+          f"b{args.batch}x{args.seq}, layers={spec.config.n_layers}",
+          file=sys.stderr)
+
+    task = Task(
+        get_model=get_model,
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=args.seq, batch_size=args.batch,
+            vocab_size=spec.config.vocab_size,
+            n_tokens=args.seq * args.batch * 4,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-4, batch_count=args.steps),
+        save_dir="/tmp/saturn_billion_ckpts",
+    )
+
+    off = HostOffload()
+    devices = jax.devices()[:1]
+    config = {"stream": bool(args.stream), "remat": bool(args.remat)}
+    bundle = off.build(task, devices, config)
+    state = bundle.init()
+    batch = jax.device_put(task.get_dataset().batch(0), bundle.batch_sharding)
+    # warmup / compile
+    state, loss = bundle.step(state, batch)
+    loss0 = float(jax.device_get(loss))
+
+    t0 = timeit.default_timer()
+    for i in range(args.steps):
+        b = jax.device_put(task.get_dataset().batch(i % 3 + 1),
+                           bundle.batch_sharding)
+        state, loss = bundle.step(state, b)
+    lossN = float(jax.device_get(loss))
+    dt = (timeit.default_timer() - t0) / args.steps
+
+    dev = jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)() or {}
+    out = {
+        "metric": "billion_scale_offload",
+        "preset": args.preset,
+        "params_b": round(n_params / 1e9, 3),
+        "batch": args.batch,
+        "seq": args.seq,
+        "config": config,
+        "samples_per_s": round(args.batch / dt, 3),
+        "tokens_per_s": round(args.batch * args.seq / dt, 1),
+        "step_s": round(dt, 3),
+        "loss_first": round(loss0, 4),
+        "loss_last": round(lossN, 4),
+        "hbm_limit_gib": round(device_hbm_bytes(dev) / 2**30, 2),
+        "hbm_peak_gib": round(stats.get("peak_bytes_in_use", 0) / 2**30, 2),
+        "platform": dev.platform,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -128,6 +128,14 @@ PRESETS: Dict[str, Dict[str, Any]] = {
         d_model=4096, n_layers=28, n_heads=16, d_ff=16384,
         rotary=True, rotary_dim=64, parallel_residual=True,
     ),
+    # GPT-J-class ~1.3B config (GPT-neo-1.3B-shaped): the single-chip
+    # billion-parameter capability row — too big for plain residency with
+    # Adam on a 16 GiB chip, the case the offload executor exists for
+    # (reference ``Spilled.py:23-28``).
+    "gptj-1b3": dict(
+        d_model=2048, n_layers=24, n_heads=16, d_ff=8192,
+        rotary=True, rotary_dim=64, parallel_residual=True,
+    ),
     "gptj-test-tiny": dict(
         d_model=64, n_layers=2, n_heads=4, vocab_size=256, seq_len=64,
         rotary=True, rotary_dim=8, parallel_residual=True,

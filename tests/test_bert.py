@@ -12,6 +12,9 @@ from saturn_tpu.models.bert import (
     mlm_loss,
 )
 
+# Model-build + executor compiles dominate on the 1-core host: slow tier.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def bert_spec():

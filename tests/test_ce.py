@@ -356,6 +356,7 @@ class TestModelFusedLoss:
         calls = self._mesh_gate_case(DataParallel(), jax.devices()[:2])
         assert calls["parts"] >= 1 and calls["fused"] == 0
 
+    @pytest.mark.slow
     def test_dp_sharded_fused_loss_matches_unsharded(self):
         """The psum'd (sum, count) mean over 2 batch shards equals the
         single-program fused mean."""

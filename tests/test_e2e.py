@@ -58,6 +58,7 @@ def test_search_then_orchestrate(tmp_path, devices8):
         assert state["step"] == 8  # all batches ran exactly once
 
 
+@pytest.mark.slow
 def test_parallel_trials_fill_strategies(tmp_path, devices8):
     """Concurrent same-size trials on disjoint blocks (the reference's Ray
     fan-out, ``PerformanceEvaluator.py:74-84``) must fill the same strategy

@@ -94,6 +94,7 @@ class TestFlashModel:
         np.testing.assert_allclose(np.asarray(ld), np.asarray(lf),
                                    rtol=2e-2, atol=2e-2)
 
+    @pytest.mark.slow
     def test_model_flash_trains(self):
         from saturn_tpu.models.gpt2 import build_gpt2
         from tests.test_models import check_trains

@@ -100,6 +100,7 @@ class TestGPT2:
             config_for("no-such-model")
 
 
+@pytest.mark.slow
 class TestGPTJ:
     """Rotary + parallel-residual family (reference ``GPTJ.py:44-79,392-424``)."""
 

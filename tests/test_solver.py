@@ -282,6 +282,7 @@ class TestWarmStart:
         bound = warm_schedule(tasks, topo(8), prev).makespan
         assert w.makespan <= bound + 1e-3
 
+    @pytest.mark.slow
     def test_resolve_warm_budget_fast(self):
         """Interval-2 re-solve gets warm_budget_frac of the budget and stays
         same-or-better than the slid previous plan (the VERDICT 'interval-2
