@@ -16,6 +16,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -53,23 +54,97 @@ inline bool ascii_space(unsigned char c) {
          c == '\f';
 }
 
-// Lowercased word (alnum run) / single punctuation-char tokens.
-void split_tokens(const std::string& text, std::vector<std::string>& toks) {
+// One fully-encoded corpus, cached between the count call and the fill
+// call of the two-call ctypes protocol — pre-round-4 both calls redid the
+// whole split/count/rank (and the token stream was a vector of 100M+
+// std::strings, ~3 GB of allocator traffic at WikiText-103 scale; the
+// interned stream below is 4 bytes/token).
+struct Encoded {
+  std::string path;
+  int max_vocab = 0;
+  long file_size = -1;
+  std::vector<int32_t> ids;          // final vocab ids, ready to copy out
+  std::vector<std::string> words;    // ranked vocab (ids 2..keep+1)
+  int vocab_size = 0;
+  bool valid = false;
+};
+
+// Single pass: intern tokens to dense first-occurrence ids, count, rank,
+// then remap the dense stream to vocab ids. Tie-break parity with the
+// Python fallback: intern order IS first-occurrence order.
+bool build_encoded(const char* path, int max_vocab, Encoded& out) {
+  std::string text;
+  if (!read_file(path, text)) return false;
+
+  std::unordered_map<std::string, int32_t> intern;
+  intern.reserve(1 << 16);
+  std::vector<long> counts;
+  std::vector<const std::string*> words;  // dense id -> token text
+  std::vector<int32_t> dense;
+  dense.reserve(text.size() / 5 + 16);
+
   std::string cur;
+  auto emit = [&](const std::string& tok) {
+    auto it = intern.find(tok);
+    int32_t id;
+    if (it == intern.end()) {
+      id = static_cast<int32_t>(intern.size());
+      auto ins = intern.emplace(tok, id);
+      counts.push_back(0);
+      words.push_back(&ins.first->first);
+    } else {
+      id = it->second;
+    }
+    ++counts[id];
+    dense.push_back(id);
+  };
+  std::string punct(1, '\0');
   for (unsigned char raw : text) {
     const unsigned char c = ascii_lower(raw);
     if (ascii_alnum_lower(c)) {
       cur.push_back(static_cast<char>(c));
     } else {
       if (!cur.empty()) {
-        toks.push_back(cur);
+        emit(cur);
         cur.clear();
       }
-      if (!ascii_space(c)) toks.emplace_back(1, static_cast<char>(c));
+      if (!ascii_space(c)) {
+        punct[0] = static_cast<char>(c);
+        emit(punct);
+      }
     }
   }
-  if (!cur.empty()) toks.push_back(cur);
+  if (!cur.empty()) emit(cur);
+
+  // Rank by (count desc, first occurrence asc == dense id asc).
+  const size_t u = counts.size();
+  std::vector<int32_t> order(u);
+  for (size_t i = 0; i < u; ++i) order[i] = static_cast<int32_t>(i);
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    if (counts[a] != counts[b]) return counts[a] > counts[b];
+    return a < b;
+  });
+  const size_t keep = std::min(u, static_cast<size_t>(max_vocab - 2));
+  std::vector<int32_t> remap(u, 1);  // default <unk>
+  out.words.clear();
+  out.words.reserve(keep);
+  for (size_t r = 0; r < keep; ++r) {
+    remap[order[r]] = static_cast<int32_t>(r + 2);
+    out.words.push_back(*words[order[r]]);
+  }
+
+  out.ids.resize(dense.size());
+  for (size_t i = 0; i < dense.size(); ++i) out.ids[i] = remap[dense[i]];
+  out.path = path;
+  out.max_vocab = max_vocab;
+  out.file_size = static_cast<long>(text.size());
+  out.vocab_size = static_cast<int>(keep + 2);
+  out.valid = true;
+  return true;
 }
+
+std::mutex g_cache_mu;
+Encoded g_cache;
 
 }  // namespace
 
@@ -82,57 +157,44 @@ long word_tokenize_file(const char* path, int max_vocab,
                         const char* vocab_out_path, int32_t* out_ids,
                         long out_capacity, int* out_vocab_size) {
   if (!path || max_vocab < 3) return -2;
-  std::string text;
-  if (!read_file(path, text)) return -1;
-
-  std::vector<std::string> toks;
-  split_tokens(text, toks);
-  const long n = static_cast<long>(toks.size());
+  if (out_ids && out_capacity < 0) return -2;  // memcpy below must not
+  //                                              underflow to a huge size_t
+  std::lock_guard<std::mutex> lock(g_cache_mu);
+  // The Python wrapper calls count (out_ids == NULL) then fill; the cache
+  // makes the pair cost ONE build. Keyed on (path, max_vocab, current file
+  // size) so a corpus rewritten between an unpaired count call and a later
+  // call re-builds instead of serving the old stream; the fill call
+  // releases the cached memory either way.
+  long cur_size = -1;
+  {
+    FILE* f = std::fopen(path, "rb");
+    if (f) {
+      std::fseek(f, 0, SEEK_END);
+      cur_size = std::ftell(f);
+      std::fclose(f);
+    }
+  }
+  if (!(g_cache.valid && g_cache.path == path &&
+        g_cache.max_vocab == max_vocab && g_cache.file_size == cur_size)) {
+    g_cache.valid = false;
+    if (!build_encoded(path, max_vocab, g_cache)) return -1;
+  }
+  const long n = static_cast<long>(g_cache.ids.size());
   if (!out_ids) return n;
 
-  // Frequency count, ranked descending (ties: first occurrence wins so the
-  // mapping is deterministic across runs).
-  std::unordered_map<std::string, std::pair<long, long>> freq;  // count, first
-  freq.reserve(toks.size() / 4 + 16);
-  for (long i = 0; i < n; ++i) {
-    auto it = freq.find(toks[i]);
-    if (it == freq.end())
-      freq.emplace(toks[i], std::make_pair(1L, i));
-    else
-      ++it->second.first;
-  }
-  std::vector<const std::pair<const std::string, std::pair<long, long>>*> ranked;
-  ranked.reserve(freq.size());
-  for (const auto& kv : freq) ranked.push_back(&kv);
-  std::sort(ranked.begin(), ranked.end(), [](const auto* a, const auto* b) {
-    if (a->second.first != b->second.first)
-      return a->second.first > b->second.first;
-    return a->second.second < b->second.second;
-  });
-
-  const size_t keep =
-      std::min(ranked.size(), static_cast<size_t>(max_vocab - 2));
-  std::unordered_map<std::string, int32_t> vocab;
-  vocab.reserve(keep * 2);
-  for (size_t r = 0; r < keep; ++r)
-    vocab.emplace(ranked[r]->first, static_cast<int32_t>(r + 2));
-  if (out_vocab_size) *out_vocab_size = static_cast<int>(keep + 2);
-
+  if (out_vocab_size) *out_vocab_size = g_cache.vocab_size;
   if (vocab_out_path && vocab_out_path[0]) {
     FILE* vf = std::fopen(vocab_out_path, "wb");
     if (vf) {
       std::fputs("<pad>\n<unk>\n", vf);
-      for (size_t r = 0; r < keep; ++r)
-        std::fprintf(vf, "%s\n", ranked[r]->first.c_str());
+      for (const auto& w : g_cache.words)
+        std::fprintf(vf, "%s\n", w.c_str());
       std::fclose(vf);
     }
   }
-
   const long m = std::min(n, out_capacity);
-  for (long i = 0; i < m; ++i) {
-    auto it = vocab.find(toks[i]);
-    out_ids[i] = (it == vocab.end()) ? 1 : it->second;
-  }
+  std::memcpy(out_ids, g_cache.ids.data(), sizeof(int32_t) * m);
+  g_cache = Encoded();  // free the ~4B/token stream eagerly
   return n;
 }
 
