@@ -76,6 +76,19 @@ def process_index() -> int:
         return 0
 
 
+def process_count() -> int:
+    import jax
+
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def is_multihost() -> bool:
+    return process_count() > 1
+
+
 def is_coordinator() -> bool:
     """True on the process that owns host-side effects — checkpoint writes
     (``utils/checkpoint.py``) and metrics files. Rank 0 by convention; the
@@ -93,3 +106,109 @@ def sync(name: str = "saturn_tpu_sync") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+def broadcast_json(obj, src: int = 0):
+    """Process ``src``'s ``obj`` (json-serializable) to every process.
+
+    The control-plane primitive behind multi-host orchestration: plans and
+    corrected profiles are DECIDED on one rank and broadcast, never
+    recomputed per rank — a time-limited solver (HiGHS) and wall-clock
+    profiling are not deterministic across processes, and divergent plans
+    would interleave collective programs differently per process (the
+    multi-controller deadlock). Two-phase: fixed-shape length first, then
+    the utf-8 payload (``broadcast_one_to_all`` needs same-shaped inputs
+    everywhere). Cluster-wide: every process must call it.
+    """
+    import json
+
+    import numpy as np
+
+    if not is_multihost():
+        return obj
+    from jax.experimental import multihost_utils
+
+    is_src = process_index() == src
+    payload = np.frombuffer(
+        json.dumps(obj).encode("utf-8"), dtype=np.uint8
+    ) if is_src else np.zeros(0, np.uint8)
+    n = multihost_utils.broadcast_one_to_all(
+        np.asarray(payload.size, np.int64), is_source=is_src
+    )
+    buf = payload if is_src else np.zeros(int(n), np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+    return json.loads(np.asarray(out).tobytes().decode("utf-8"))
+
+
+def put_global(host_array, sharding):
+    """``device_put`` that also works when ``sharding`` spans processes.
+
+    Every process holds the FULL host value (saturn_tpu datasets are
+    deterministic and instantiated per process); each device takes its own
+    slice, so nothing crosses DCN for batch placement."""
+    import jax
+
+    if not is_multihost():
+        return jax.device_put(host_array, sharding)
+    import numpy as np
+
+    arr = np.asarray(host_array)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def put_tree_global(tree, shardings):
+    """Tree version of :func:`put_global` (checkpoint-restore placement)."""
+    import jax
+
+    if not is_multihost():
+        return jax.device_put(tree, shardings)
+    return jax.tree_util.tree_map(put_global, tree, shardings)
+
+
+def host_scalar(x) -> float:
+    """Read a (replicated) device scalar on every process — ``device_get``
+    refuses arrays that are not fully addressable."""
+    import jax
+    import numpy as np
+
+    if getattr(x, "is_fully_addressable", True):
+        return float(jax.device_get(x))
+    return float(np.asarray(x.addressable_data(0)))
+
+
+def sync_task_state(task_list, src_ranks=None) -> None:
+    """Make every rank's strategy numbers identical — the multi-host
+    forecast precondition (budgets derive from per-batch times; divergent
+    budgets mean divergent collective program counts = deadlock).
+
+    ``src_ranks``: task name -> the process whose numbers win. The
+    orchestrator passes each task's executing (lowest-block) rank so
+    realized-feedback corrections from host-local tasks survive; with no
+    plan yet (the pre-loop profile sync) rank 0 wins. One broadcast per
+    distinct source rank, deterministic order, every process participates.
+    """
+    if not is_multihost():
+        return
+    src_ranks = src_ranks or {}
+    by_src: dict = {}
+    for t in task_list:
+        by_src.setdefault(int(src_ranks.get(t.name, 0)), []).append(t)
+    for src in sorted(by_src):
+        group = by_src[src]
+        state = None
+        if process_index() == src:
+            state = {
+                t.name: {
+                    str(g): [s.per_batch_time, s.runtime]
+                    for g, s in t.strategies.items()
+                }
+                for t in group
+            }
+        state = broadcast_json(state, src=src)
+        for t in group:
+            for g_str, (pbt, rt) in state.get(t.name, {}).items():
+                s = t.strategies.get(int(g_str))
+                if s is not None:
+                    s.per_batch_time = pbt
+                    s.runtime = rt

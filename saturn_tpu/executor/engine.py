@@ -89,6 +89,12 @@ def execute(
     failure isolation the reference lacks (SURVEY.md §5 "no elasticity").
     Either way every other task finishes its interval first.
     """
+    from saturn_tpu.core import distributed
+
+    if distributed.is_multihost():
+        return _execute_multihost(run_tasks, batches, interval, plan,
+                                  topology, failure_policy)
+
     events = {t.name: threading.Event() for t in run_tasks}
     running = {t.name for t in run_tasks}
     errors: Dict[str, BaseException] = {}
@@ -140,4 +146,79 @@ def execute(
         logger.info("interval overran: %.1fs vs planned %.1fs", elapsed, interval)
     else:
         logger.info("interval finished early: %.1fs of %.1fs", elapsed, interval)
+    return errors
+
+
+def _execute_multihost(
+    run_tasks, batches, interval, plan, topology, failure_policy,
+) -> Dict[str, BaseException]:
+    """Multi-process interval: SEQUENTIAL, deterministic program order.
+
+    Multi-controller JAX requires every pair of processes to enqueue their
+    shared programs in the same order — the single-host thread gang cannot
+    guarantee that, so cross-host intervals serialize tasks by planned
+    (start, name). Each process executes only tasks whose block touches its
+    local devices (a program over purely-remote devices has no local
+    computation) but advances EVERY task's bookkeeping, keeping per-rank
+    task state identical. Ordering edges are satisfied by construction: an
+    overlap dependency always has an earlier planned start.
+    """
+    import jax
+
+    from saturn_tpu.core import distributed
+
+    my_proc = jax.process_index()
+    errors: Dict[str, BaseException] = {}
+    ordered = sorted(
+        run_tasks, key=lambda t: (plan.assignments[t.name].start, t.name)
+    )
+    t0 = timeit.default_timer()
+    for tid, task in enumerate(ordered):
+        a = plan.assignments[task.name]
+        task.select_strategy(a.apportionment)
+        devices = topology.block_devices(a.block)
+        local = any(
+            getattr(d, "process_index", 0) == my_proc for d in devices
+        )
+        try:
+            if local:
+                n = batches[task.name]
+                logger.info(
+                    "interval[mh]: %s on block [%d:%d] for %d batches",
+                    task.name, a.block.offset, a.block.end, n,
+                )
+                task.selected_strategy.executor.execute(
+                    task, devices, tid, override_batch_count=n
+                )
+            task.reconfigure(batches[task.name])
+        except BaseException as e:
+            # Fail FAST, before any barrier or further collective: healthy
+            # ranks may be ahead in cross-process programs, and this rank
+            # parking at a barrier while they wait in a collective is a
+            # mutual hang. Raising here exits the process; the jax
+            # coordination service then aborts the rest of the cluster
+            # (multi-host supports failure_policy='raise' only).
+            logger.exception("task %s failed during interval", task.name)
+            metrics.event(
+                "interval", elapsed_s=timeit.default_timer() - t0,
+                planned_s=interval, n_tasks=len(run_tasks),
+                failed=[task.name],
+            )
+            raise RuntimeError(
+                f"interval execution failed for task {task.name}"
+            ) from e
+    # Interval-end durability point: join this rank's async checkpoint
+    # writes, then barrier. Forfeits the single-host write/compute overlap,
+    # but guarantees every rank sees identical shared-FS state before the
+    # next interval's exists()/restore() decisions — the alternative
+    # (collectives inside checkpoint reads) deadlocks for host-local tasks.
+    from saturn_tpu.utils import checkpoint as _ckpt
+
+    _ckpt.flush()
+    distributed.sync("interval-end")
+    elapsed = timeit.default_timer() - t0
+    metrics.event(
+        "interval", elapsed_s=elapsed, planned_s=interval,
+        n_tasks=len(run_tasks), failed=[],
+    )
     return errors

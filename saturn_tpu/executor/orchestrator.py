@@ -59,6 +59,17 @@ def orchestrate(
         raise ValueError(
             f"failure_policy must be 'raise', 'drop' or 'retry', got {failure_policy!r}"
         )
+    from saturn_tpu.core import distributed
+
+    if distributed.is_multihost() and failure_policy != "raise":
+        # drop/retry mutate the task set from a per-rank error view; until
+        # errors are all-gathered, divergent task lists would interleave
+        # collective programs differently per process (multi-controller
+        # deadlock). A failed rank aborts the cluster through the jax
+        # coordination service instead.
+        raise ValueError(
+            "multi-host orchestration supports failure_policy='raise' only"
+        )
     topo = topology if topology is not None else SliceTopology()
     names = [t.name for t in task_list]
     if len(set(names)) != len(names):
@@ -106,8 +117,29 @@ def _orchestrate_loop(
     max_task_retries, metrics_path, trace_dir,
     all_completed, all_failed, retries,
 ) -> dict:
+    from saturn_tpu.core import distributed
+
+    multihost = distributed.is_multihost()
     with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
-        plan = milp.solve(task_list, topo, time_limit=tlimit)  # initial blocking solve
+        if multihost:
+            # Profile sync BEFORE the first forecast: per-process wall-clock
+            # profiling yields slightly different per-batch times, and
+            # forecast budgets derived from divergent numbers mean divergent
+            # collective program counts (multi-controller deadlock). The
+            # coordinator's trial numbers win here; per-interval syncs below
+            # use each task's executing rank.
+            distributed.sync_task_state(task_list)
+        # Multi-host: ONLY the coordinator solves (a time-limited HiGHS run
+        # is not deterministic across processes); every rank executes the
+        # same broadcast plan. Single-host: unchanged.
+        if not multihost or distributed.is_coordinator():
+            plan = milp.solve(task_list, topo, time_limit=tlimit)  # initial blocking solve
+        else:
+            plan = None
+        if multihost:
+            plan = milp.Plan.from_json(
+                distributed.broadcast_json(plan.to_json() if plan else None)
+            )
         logger.info("initial plan: makespan %.1fs, %d tasks", plan.makespan, len(task_list))
         metrics.event("solve", makespan_s=plan.makespan, n_tasks=len(task_list))
 
@@ -117,7 +149,7 @@ def _orchestrate_loop(
                 remaining = [t for t in task_list if t not in completed]
 
                 future = None
-                if remaining:
+                if remaining and (not multihost or distributed.is_coordinator()):
                     # overlap next-interval solve with this interval's execution
                     # (``orchestrator.py:69-71``)
                     future = pool.submit(
@@ -135,7 +167,18 @@ def _orchestrate_loop(
                     # it): the slide in resolve() brings work forward next round.
                     logger.info("idle interval: no task starts within %.1fs", interval)
 
-                if future is not None:
+                if multihost and remaining:
+                    # Every rank must reach this broadcast; the coordinator
+                    # contributes its joined re-solve.
+                    new_plan = future.result().to_json() if future else None
+                    future = None
+                    plan = milp.Plan.from_json(
+                        distributed.broadcast_json(new_plan)
+                    )
+                    logger.info("re-solve: makespan %.1fs", plan.makespan)
+                    metrics.event("solve", makespan_s=plan.makespan,
+                                  n_tasks=len(remaining))
+                elif future is not None:
                     # Join the overlapped solve BEFORE the failure handling
                     # below mutates Task/Strategy state the solver thread
                     # reads (retry rollback rewrites strategy runtimes).
@@ -167,6 +210,21 @@ def _orchestrate_loop(
                                 "estimate correction for %s: %.3fs -> %.3fs "
                                 "per batch", t.name, old, new,
                             )
+                if multihost and run_tasks:
+                    # All ranks must forecast from identical numbers. Each
+                    # task's numbers come from the rank that actually ran it
+                    # (the lowest process of its block) — broadcasting the
+                    # coordinator's view would throw away realized-feedback
+                    # corrections for tasks on other hosts' blocks forever.
+                    src = {}
+                    for t in run_tasks:
+                        a = plan.assignments.get(t.name)
+                        if a is not None:
+                            devs = topo.block_devices(a.block)
+                            src[t.name] = min(
+                                getattr(d, "process_index", 0) for d in devs
+                            )
+                    distributed.sync_task_state(run_tasks, src)
 
                 if errors:  # "drop": evict failed tasks; "retry": give them
                     # max_task_retries more intervals first

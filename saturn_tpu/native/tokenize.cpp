@@ -21,6 +21,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include <sys/stat.h>
+
 namespace {
 
 bool read_file(const char* path, std::string& out) {
@@ -63,11 +65,24 @@ struct Encoded {
   std::string path;
   int max_vocab = 0;
   long file_size = -1;
+  double file_mtime = -1.0;
   std::vector<int32_t> ids;          // final vocab ids, ready to copy out
   std::vector<std::string> words;    // ranked vocab (ids 2..keep+1)
   int vocab_size = 0;
   bool valid = false;
 };
+
+// (size, mtime) of a file — the cache staleness key; (-1, -1) if stat fails.
+void stat_file(const char* path, long* size, double* mtime) {
+  *size = -1;
+  *mtime = -1.0;
+  struct stat st;
+  if (::stat(path, &st) == 0) {
+    *size = static_cast<long>(st.st_size);
+    *mtime = static_cast<double>(st.st_mtim.tv_sec) +
+             1e-9 * static_cast<double>(st.st_mtim.tv_nsec);
+  }
+}
 
 // Single pass: intern tokens to dense first-occurrence ids, count, rank,
 // then remap the dense stream to vocab ids. Tie-break parity with the
@@ -161,23 +176,20 @@ long word_tokenize_file(const char* path, int max_vocab,
   //                                              underflow to a huge size_t
   std::lock_guard<std::mutex> lock(g_cache_mu);
   // The Python wrapper calls count (out_ids == NULL) then fill; the cache
-  // makes the pair cost ONE build. Keyed on (path, max_vocab, current file
-  // size) so a corpus rewritten between an unpaired count call and a later
-  // call re-builds instead of serving the old stream; the fill call
-  // releases the cached memory either way.
-  long cur_size = -1;
-  {
-    FILE* f = std::fopen(path, "rb");
-    if (f) {
-      std::fseek(f, 0, SEEK_END);
-      cur_size = std::ftell(f);
-      std::fclose(f);
-    }
-  }
+  // makes the pair cost ONE build. Keyed on (path, max_vocab, file size,
+  // file mtime) so a corpus rewritten between an unpaired count call and a
+  // later call re-builds — size alone misses same-length rewrites; the
+  // fill call releases the cached memory either way.
+  long cur_size;
+  double cur_mtime;
+  stat_file(path, &cur_size, &cur_mtime);
   if (!(g_cache.valid && g_cache.path == path &&
-        g_cache.max_vocab == max_vocab && g_cache.file_size == cur_size)) {
+        g_cache.max_vocab == max_vocab && g_cache.file_size == cur_size &&
+        g_cache.file_mtime == cur_mtime)) {
     g_cache.valid = false;
     if (!build_encoded(path, max_vocab, g_cache)) return -1;
+    g_cache.file_size = cur_size;
+    g_cache.file_mtime = cur_mtime;
   }
   const long n = static_cast<long>(g_cache.ids.size());
   if (!out_ids) return n;

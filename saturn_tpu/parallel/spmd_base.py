@@ -468,8 +468,10 @@ class SPMDTechnique(BaseTechnique):
             # Resume — restore host arrays and place them under THIS
             # technique's shardings (cross-technique resharding; the
             # reference's kill-and-respawn reload, ``FSDP.py:189-191``).
+            from saturn_tpu.core import distributed as _dist
+
             host_state = ckpt.restore(task.ckpt_path, bundle.state_shapes)
-            state = jax.device_put(host_state, bundle.state_shardings)
+            state = _dist.put_tree_global(host_state, bundle.state_shardings)
             # Data cursor is derived from the trained-step count, so resume
             # is restart-safe (the reference replayed the iterator from the
             # in-memory cursor only, ``Task.py:130-140``).
@@ -487,17 +489,21 @@ class SPMDTechnique(BaseTechnique):
             n = task.total_batches
         n = int(n)
 
+        from saturn_tpu.core import distributed as _dist
+
         start = task.current_batch
         loss = None
         t0 = _timeit.default_timer()
         for i in range(n):
-            batch = jax.device_put(
+            # put_global == device_put single-process; on a multi-host
+            # block each process's devices take their slice locally
+            batch = _dist.put_global(
                 task.batch_at(start + i), bundle.batch_sharding
             )
             state, loss = bundle.compiled(state, batch)
         if loss is not None:
             # host read = reliable queue drain (see utils/timing.py note)
-            loss_val = float(jax.device_get(loss))
+            loss_val = _dist.host_scalar(loss)
             elapsed = _timeit.default_timer() - t0
             bs = task.get_dataset().batch_size
             sps = n * bs / max(elapsed, 1e-9)

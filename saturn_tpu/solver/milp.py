@@ -68,6 +68,33 @@ class Plan:
                         deps[n1].append(n2)
         self.dependencies = deps
 
+    # Wire format for the multi-host control plane: the coordinator solves,
+    # every rank executes the SAME decoded plan (core/distributed.py
+    # broadcast_json) — a time-limited HiGHS run is not deterministic
+    # across processes.
+    def to_json(self) -> dict:
+        return {
+            "makespan": self.makespan,
+            "assignments": {
+                n: [a.apportionment, a.block.offset, a.block.size, a.start,
+                    a.runtime]
+                for n, a in self.assignments.items()
+            },
+            "dependencies": self.dependencies,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "Plan":
+        return Plan(
+            assignments={
+                n: Assignment(int(app), Block(int(off), int(size)), float(st),
+                              float(rt))
+                for n, (app, off, size, st, rt) in d["assignments"].items()
+            },
+            makespan=float(d["makespan"]),
+            dependencies={k: list(v) for k, v in d["dependencies"].items()},
+        )
+
 
 class DeviceTimeline:
     """Per-device busy intervals with the earliest-free-slot rule.

@@ -31,10 +31,26 @@ from saturn_tpu.utils.treepath import path_str as _path_str
 log = logging.getLogger("saturn_tpu")
 
 
-def _is_coordinator() -> bool:
+def _writer_rank(tree: Any) -> int:
+    """The process that writes this tree: the lowest process index that
+    addresses its arrays. For a cross-host sharded/replicated state that is
+    the coordinator; for a state living entirely on one host's devices it
+    is that host (the coordinator never even sees the tree — the multi-host
+    engine only calls execute() on processes local to the task's block).
+    Host-only trees (plain numpy) default to rank 0."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        ds = getattr(getattr(leaf, "sharding", None), "device_set", None)
+        if ds:
+            return min(getattr(d, "process_index", 0) for d in ds)
+    return 0
+
+
+def _should_write(tree: Any) -> bool:
     from saturn_tpu.core import distributed
 
-    return distributed.is_coordinator()
+    if not distributed.is_multihost():
+        return True
+    return distributed.process_index() == _writer_rank(tree)
 
 
 def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
@@ -53,9 +69,24 @@ def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
             hasattr(leaf, "is_fully_addressable")
             and not leaf.is_fully_addressable
         ):
-            from jax.experimental import multihost_utils
+            # Replicate over the leaf's OWN mesh — a program involving
+            # exactly the processes that address it (all of which call
+            # save, since the engine runs execute() on every block-local
+            # rank). A cluster-wide allgather here would hang processes
+            # that are not part of this task's block on 3+ host clusters.
+            from jax.sharding import NamedSharding, PartitionSpec
 
-            leaf = multihost_utils.process_allgather(leaf, tiled=True)
+            mesh = getattr(leaf.sharding, "mesh", None)
+            if mesh is not None:
+                rep = jax.jit(
+                    lambda a: a,
+                    out_shardings=NamedSharding(mesh, PartitionSpec()),
+                )(leaf)
+                leaf = rep.addressable_data(0)
+            else:  # non-mesh sharding: fall back to the global gather
+                from jax.experimental import multihost_utils
+
+                leaf = multihost_utils.process_allgather(leaf, tiled=True)
         arr = np.asarray(jax.device_get(leaf))
         # npz can't round-trip ml_dtypes (bfloat16/fp8); widen to float32 —
         # restore() narrows back to the template's dtype.
@@ -80,9 +111,11 @@ def _write_atomic(path: str, arrays: Dict[str, np.ndarray]) -> None:
 
 def save(path: str, tree: Any) -> None:
     """Atomically write a pytree checkpoint to ``path`` (an ``.npz`` file).
-    Multi-host: collective gather on every rank, write on rank 0 only."""
+    Multi-host: collective gather on every participating rank; the write
+    happens on the tree's writer rank only (see ``_writer_rank``)."""
+    should = _should_write(tree)
     arrays = flatten_to_host(tree)
-    if _is_coordinator():
+    if should:
         _write_atomic(path, arrays)
 
 
@@ -121,14 +154,17 @@ def save_async(path: str, tree: Any) -> None:
     ``save``). ``flush()`` joins all outstanding writes; a failed write
     re-raises from the next join point on the same path (or ``flush``).
 
-    Multi-host: every process participates in the device->host gather (a
-    collective), but only the coordinator (rank 0) touches the filesystem —
-    N processes racing one atomic rename on shared storage would be wasted
-    I/O at best. Readers on other ranks barrier via ``distributed.sync``.
+    Multi-host: every participating process joins the device->host gather
+    (a collective for cross-host arrays), but only the tree's writer rank
+    (``_writer_rank`` — lowest process addressing it) touches the
+    filesystem; N processes racing one atomic rename on shared storage
+    would be wasted I/O at best. The multi-host engine flushes + barriers
+    at interval end so readers never race the write (``engine.py``).
     """
     _wait_pending(path)  # at most one in-flight write per path
+    should = _should_write(tree)
     arrays = flatten_to_host(tree)
-    if not _is_coordinator():
+    if not should:
         return
     key = os.path.abspath(path)
 
@@ -170,16 +206,13 @@ def restore(path: str, template: Any) -> Any:
     are replaced by the saved arrays with dtype preserved from the template so
     a bf16 param set restores as bf16 even though numpy stored it widened.
 
-    Multi-host: restore is a collective — every rank must call it (the
-    shared-FS contract). The barrier below runs AFTER the coordinator joins
-    its own in-flight async write, so no rank can read a half-written or
-    stale file; without it, a non-coordinator (which never has a pending
-    write to wait on) could race the coordinator's atomic rename.
+    Multi-host: the writer rank's _wait_pending joins its own in-flight
+    write; OTHER ranks rely on the engine's interval-end flush+barrier
+    (``engine._execute_multihost``) having run before any cross-rank read —
+    no collective here, because a task local to one host restores on that
+    host alone and a cluster-wide barrier would deadlock.
     """
     _wait_pending(path)  # an async save to this path may still be in flight
-    from saturn_tpu.core import distributed
-
-    distributed.sync(f"ckpt-restore:{os.path.basename(path)}")
     with np.load(path) as data:
         saved = {k: data[k] for k in data.files}
 
@@ -203,6 +236,11 @@ def restore(path: str, template: Any) -> Any:
 
 def exists(path: str) -> bool:
     """True if a checkpoint exists (joining any in-flight async write first,
-    so a just-scheduled save counts)."""
+    so a just-scheduled save counts).
+
+    Multi-host: consistency across ranks comes from the engine's
+    interval-end flush+barrier — by the time any rank asks, the shared-FS
+    file is durable, so every rank reads the same answer with no
+    collective (which would deadlock for host-local tasks)."""
     _wait_pending(path)
     return os.path.exists(path)
