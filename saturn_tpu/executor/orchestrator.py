@@ -120,6 +120,11 @@ def _orchestrate_loop(
     from saturn_tpu.core import distributed
 
     multihost = distributed.is_multihost()
+    if multihost and not distributed.is_coordinator():
+        # One writer per metrics file: every rank appending the same JSONL
+        # on shared storage would duplicate each event N-fold (and NFS
+        # O_APPEND interleaving is not line-atomic).
+        metrics_path = None
     with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
         if multihost:
             # Profile sync BEFORE the first forecast: per-process wall-clock
@@ -156,6 +161,13 @@ def _orchestrate_loop(
                         milp.resolve, remaining, topo, plan, interval, threshold, tlimit
                     )
 
+                # Snapshot the EXECUTED plan's assignments before the
+                # re-solve broadcast replaces `plan`: feedback source ranks
+                # must name the rank that actually ran each task, not where
+                # the next plan happens to move it.
+                executed_assignments = {
+                    t.name: plan.assignments.get(t.name) for t in run_tasks
+                }
                 errors: dict = {}
                 if run_tasks:
                     errors = engine.execute(
@@ -213,12 +225,13 @@ def _orchestrate_loop(
                 if multihost and run_tasks:
                     # All ranks must forecast from identical numbers. Each
                     # task's numbers come from the rank that actually ran it
-                    # (the lowest process of its block) — broadcasting the
-                    # coordinator's view would throw away realized-feedback
-                    # corrections for tasks on other hosts' blocks forever.
+                    # (the lowest process of its EXECUTED block) —
+                    # broadcasting the coordinator's view would throw away
+                    # realized-feedback corrections for tasks on other
+                    # hosts' blocks forever.
                     src = {}
                     for t in run_tasks:
-                        a = plan.assignments.get(t.name)
+                        a = executed_assignments.get(t.name)
                         if a is not None:
                             devs = topo.block_devices(a.block)
                             src[t.name] = min(

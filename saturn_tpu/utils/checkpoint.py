@@ -69,19 +69,21 @@ def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
             hasattr(leaf, "is_fully_addressable")
             and not leaf.is_fully_addressable
         ):
-            # Replicate over the leaf's OWN mesh — a program involving
+            # Replicate over the leaf's OWN mesh — a transfer involving
             # exactly the processes that address it (all of which call
             # save, since the engine runs execute() on every block-local
             # rank). A cluster-wide allgather here would hang processes
             # that are not part of this task's block on 3+ host clusters.
+            # device_put (not a per-leaf jit identity) so repeated saves
+            # don't retrace/compile hundreds of leaves on the interval-end
+            # critical path.
             from jax.sharding import NamedSharding, PartitionSpec
 
             mesh = getattr(leaf.sharding, "mesh", None)
             if mesh is not None:
-                rep = jax.jit(
-                    lambda a: a,
-                    out_shardings=NamedSharding(mesh, PartitionSpec()),
-                )(leaf)
+                rep = jax.device_put(
+                    leaf, NamedSharding(mesh, PartitionSpec())
+                )
                 leaf = rep.addressable_data(0)
             else:  # non-mesh sharding: fall back to the global gather
                 from jax.experimental import multihost_utils

@@ -229,6 +229,17 @@ class TestEstimateFeedback:
         assert t.apply_realized_feedback() is not None
         assert t.apply_realized_feedback() is None  # no double-count
 
+    def test_multihost_rejects_drop_and_retry(self, monkeypatch):
+        """drop/retry mutate the task set from a per-rank error view —
+        multi-host orchestration must refuse them up front."""
+        from saturn_tpu.core import distributed
+
+        monkeypatch.setattr(distributed, "is_multihost", lambda: True)
+        t = FakeTask("a", 5, [4], RecordingTech())
+        for policy in ("drop", "retry"):
+            with pytest.raises(ValueError, match="raise"):
+                orchestrate([t], topology=topo(8), failure_policy=policy)
+
     def test_orchestrate_corrects_profile(self, tmp_path):
         """A 1000x-pessimistic profile is pulled toward the realized time
         during the run, and the correction is recorded in metrics."""
