@@ -177,7 +177,7 @@ def host_scalar(x) -> float:
     return float(np.asarray(x.addressable_data(0)))
 
 
-def sync_task_state(task_list, src_ranks=None) -> None:
+def sync_task_state(task_list, src_ranks=None, updates=None) -> dict:
     """Make every rank's strategy numbers identical — the multi-host
     forecast precondition (budgets derive from per-batch times; divergent
     budgets mean divergent collective program counts = deadlock).
@@ -187,28 +187,45 @@ def sync_task_state(task_list, src_ranks=None) -> None:
     realized-feedback corrections from host-local tasks survive; with no
     plan yet (the pre-loop profile sync) rank 0 wins. One broadcast per
     distinct source rank, deterministic order, every process participates.
+
+    ``updates``: this rank's {task: (old, new)} feedback corrections; each
+    source rank's entries for its own tasks ride the broadcast, and the
+    MERGED map is returned on every rank — so the coordinator (the only
+    metrics writer in multi-host runs) can emit estimate_update events for
+    corrections that happened on other hosts.
     """
     if not is_multihost():
-        return
+        return dict(updates or {})
     src_ranks = src_ranks or {}
+    updates = updates or {}
     by_src: dict = {}
     for t in task_list:
         by_src.setdefault(int(src_ranks.get(t.name, 0)), []).append(t)
+    merged_updates: dict = {}
     for src in sorted(by_src):
         group = by_src[src]
-        state = None
+        payload = None
         if process_index() == src:
-            state = {
-                t.name: {
-                    str(g): [s.per_batch_time, s.runtime]
-                    for g, s in t.strategies.items()
-                }
-                for t in group
+            payload = {
+                "state": {
+                    t.name: {
+                        str(g): [s.per_batch_time, s.runtime]
+                        for g, s in t.strategies.items()
+                    }
+                    for t in group
+                },
+                "updates": {
+                    t.name: list(updates[t.name])
+                    for t in group if t.name in updates
+                },
             }
-        state = broadcast_json(state, src=src)
+        payload = broadcast_json(payload, src=src)
         for t in group:
-            for g_str, (pbt, rt) in state.get(t.name, {}).items():
+            for g_str, (pbt, rt) in payload["state"].get(t.name, {}).items():
                 s = t.strategies.get(int(g_str))
                 if s is not None:
                     s.per_batch_time = pbt
                     s.runtime = rt
+        for name, pair in payload["updates"].items():
+            merged_updates[name] = tuple(pair)
+    return merged_updates

@@ -208,27 +208,22 @@ def _orchestrate_loop(
                 # is reading strategy state; the NEXT re-solve and forecast
                 # consume the corrected numbers. The reference only logged
                 # this error (``executor.py:126-129``).
+                local_updates = {}
                 for t in run_tasks:
                     apply_fb = getattr(t, "apply_realized_feedback", None)
                     upd = apply_fb() if apply_fb is not None else None
                     if upd is not None:
-                        old, new = upd
-                        metrics.event(
-                            "estimate_update", task=t.name,
-                            profiled_s=round(old, 6), updated_s=round(new, 6),
-                        )
-                        if abs(new - old) > 0.25 * max(old, 1e-9):
-                            logger.info(
-                                "estimate correction for %s: %.3fs -> %.3fs "
-                                "per batch", t.name, old, new,
-                            )
+                        local_updates[t.name] = upd
+                all_updates = local_updates
                 if multihost and run_tasks:
                     # All ranks must forecast from identical numbers. Each
                     # task's numbers come from the rank that actually ran it
                     # (the lowest process of its EXECUTED block) —
                     # broadcasting the coordinator's view would throw away
                     # realized-feedback corrections for tasks on other
-                    # hosts' blocks forever.
+                    # hosts' blocks forever. The merged update map rides the
+                    # same broadcast so the coordinator (sole metrics
+                    # writer) records corrections made on other hosts.
                     src = {}
                     for t in run_tasks:
                         a = executed_assignments.get(t.name)
@@ -237,7 +232,19 @@ def _orchestrate_loop(
                             src[t.name] = min(
                                 getattr(d, "process_index", 0) for d in devs
                             )
-                    distributed.sync_task_state(run_tasks, src)
+                    all_updates = distributed.sync_task_state(
+                        run_tasks, src, local_updates
+                    )
+                for name, (old, new) in sorted(all_updates.items()):
+                    metrics.event(
+                        "estimate_update", task=name,
+                        profiled_s=round(old, 6), updated_s=round(new, 6),
+                    )
+                    if abs(new - old) > 0.25 * max(old, 1e-9):
+                        logger.info(
+                            "estimate correction for %s: %.3fs -> %.3fs "
+                            "per batch", name, old, new,
+                        )
 
                 if errors:  # "drop": evict failed tasks; "retry": give them
                     # max_task_retries more intervals first

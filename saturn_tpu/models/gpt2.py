@@ -75,6 +75,15 @@ class GPT2Config:
     # parallel attention paths assume causal, so seq techniques are only
     # feasible for causal configs.
     causal: bool = True
+    # Llama-class structure knobs (beyond the reference's GPT-2/GPT-J zoo):
+    # RMSNorm instead of LayerNorm, SwiGLU instead of GELU, and
+    # grouped-query attention (n_kv_heads < n_heads; k/v heads are repeated
+    # to n_heads before the attention kernels, so flash/ring/ulysses are
+    # unchanged). n_kv_heads=None keeps the fused 3D qkv projection and
+    # exact param-shape compatibility with every earlier preset.
+    norm: str = "layernorm"          # "layernorm" | "rmsnorm"
+    mlp_act: str = "gelu"            # "gelu" | "swiglu"
+    n_kv_heads: Optional[int] = None
     # lax.scan unroll factor for the layer stack. The round-3 profiler trace
     # showed the scan's dynamic-update-slice activation stashing dragging
     # the MLP matmul fusions to ~0.4-0.5 efficiency; unrolling lets XLA
@@ -100,6 +109,19 @@ class GPT2Config:
                     f"rotary_dim must be even and <= head_dim "
                     f"({self.head_dim}), got {rd}"
                 )
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"norm must be 'layernorm' or 'rmsnorm', "
+                             f"got {self.norm!r}")
+        if self.mlp_act not in ("gelu", "swiglu"):
+            raise ValueError(f"mlp_act must be 'gelu' or 'swiglu', "
+                             f"got {self.mlp_act!r}")
+        if self.n_kv_heads is not None and (
+            self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads != 0
+        ):
+            raise ValueError(
+                f"n_kv_heads must divide n_heads ({self.n_heads}), "
+                f"got {self.n_kv_heads}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -139,6 +161,23 @@ PRESETS: Dict[str, Dict[str, Any]] = {
     "gptj-test-tiny": dict(
         d_model=64, n_layers=2, n_heads=4, vocab_size=256, seq_len=64,
         rotary=True, rotary_dim=8, parallel_residual=True,
+    ),
+    # Llama-class family (beyond the reference zoo): RMSNorm + SwiGLU +
+    # full-head rotary + grouped-query attention. Shapes follow the public
+    # TinyLlama-1.1B and Llama-3-8B configs; vocab stays this framework's
+    # 50304 (tied embedding head, native tokenizer world).
+    "llama-1b": dict(
+        d_model=2048, n_layers=22, n_heads=32, n_kv_heads=4, d_ff=5632,
+        rotary=True, norm="rmsnorm", mlp_act="swiglu",
+    ),
+    "llama-8b": dict(
+        d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        rotary=True, norm="rmsnorm", mlp_act="swiglu",
+    ),
+    "llama-test-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+        vocab_size=256, seq_len=64, rotary=True, norm="rmsnorm",
+        mlp_act="swiglu",
     ),
     # Switch-style MoE family (extension beyond the reference; SURVEY.md §2.3
     # lists EP as absent there).
@@ -194,6 +233,13 @@ def resolve_attention(cfg: GPT2Config) -> GPT2Config:
     return replace(cfg, attention="flash" if flash_supported(cfg) else "dense")
 
 
+def _norm_cls(cfg: GPT2Config):
+    """The ONE place the cfg.norm choice maps to a flax module class —
+    Block norms, the model's ln_f, and the pipeline head must stay in
+    sync."""
+    return nn.RMSNorm if cfg.norm == "rmsnorm" else nn.LayerNorm
+
+
 class Block(nn.Module):
     """Pre-LN transformer block, scan-compatible signature.
 
@@ -210,15 +256,31 @@ class Block(nn.Module):
         dt, pdt = cfg.dtype, cfg.param_dtype
         B, T, D = x.shape
 
+        def make_norm(name):
+            return _norm_cls(cfg)(dtype=dt, param_dtype=pdt, name=name)
+
         # ---- attention ----
-        h = nn.LayerNorm(dtype=dt, param_dtype=pdt, name="ln_1")(x)
-        qkv = nn.Dense(3 * D, dtype=dt, param_dtype=pdt, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        h = make_norm("ln_1")(x)
+        if cfg.n_kv_heads is None:
+            qkv = nn.Dense(3 * D, dtype=dt, param_dtype=pdt, name="qkv")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            kv_heads = cfg.n_heads
+        else:
+            # Grouped-query attention: k/v carry n_kv_heads; one fused
+            # projection sized D + 2 * kv_dim.
+            kv_heads = cfg.n_kv_heads
+            kv_dim = kv_heads * cfg.head_dim
+            qkv = nn.Dense(D + 2 * kv_dim, dtype=dt, param_dtype=pdt,
+                           name="qkv")(h)
+            q = qkv[..., :D]
+            k = qkv[..., D:D + kv_dim]
+            v = qkv[..., D + kv_dim:]
 
-        def heads(t):
-            return t.reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        def heads(t, n):
+            return t.reshape(B, T, n, cfg.head_dim).transpose(0, 2, 1, 3)
 
-        q, k, v = heads(q), heads(k), heads(v)
+        q = heads(q, cfg.n_heads)
+        k, v = heads(k, kv_heads), heads(v, kv_heads)
         if cfg.rotary:
             rd = cfg.rotary_dim or cfg.head_dim
             if cfg.seq_axis is not None:
@@ -229,6 +291,13 @@ class Block(nn.Module):
             sin, cos = rotary_sin_cos(jnp.arange(T) + offset, rd)
             q = apply_rotary(q, sin, cos, rd)
             k = apply_rotary(k, sin, cos, rd)
+        if kv_heads != cfg.n_heads:
+            # GQA: repeat k/v head groups up to n_heads so every attention
+            # path (dense/flash/ring/ulysses) sees matched head counts. The
+            # params stay at kv_heads — the repeat is activation-only.
+            rep = cfg.n_heads // kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
         if cfg.seq_axis is not None:
             if cfg.seq_mode == "ulysses":
                 from saturn_tpu.ops.ulysses import ulysses_attention
@@ -262,8 +331,22 @@ class Block(nn.Module):
         def mlp(inp):
             if cfg.moe:
                 return self._moe_mlp(inp)
-            m = nn.Dense(cfg.ff_dim, dtype=dt, param_dtype=pdt, name="mlp_in")(inp)
-            m = nn.gelu(m, approximate=True)
+            if cfg.mlp_act == "swiglu":
+                # Separate gate/up projections (NOT one fused 2F Dense): the
+                # TP column rule shards each kernel's output dim, so
+                # gate_i/up_i stay on the same model shard and silu(gate)*up
+                # is local — a fused contiguous split would put all gate
+                # columns on shard 0 and force a full-activation reshard
+                # per layer.
+                gate = nn.Dense(cfg.ff_dim, dtype=dt, param_dtype=pdt,
+                                name="mlp_gate")(inp)
+                up = nn.Dense(cfg.ff_dim, dtype=dt, param_dtype=pdt,
+                              name="mlp_in")(inp)
+                m = nn.silu(gate) * up
+            else:
+                m = nn.Dense(cfg.ff_dim, dtype=dt, param_dtype=pdt,
+                             name="mlp_in")(inp)
+                m = nn.gelu(m, approximate=True)
             return nn.Dense(D, dtype=dt, param_dtype=pdt, name="mlp_out")(m)
 
         if cfg.parallel_residual:
@@ -272,7 +355,7 @@ class Block(nn.Module):
             x = x + attn + mlp(h)
         else:
             x = x + attn
-            h2 = nn.LayerNorm(dtype=dt, param_dtype=pdt, name="ln_2")(x)
+            h2 = make_norm("ln_2")(x)
             x = x + mlp(h2)
         return x, None
 
@@ -359,7 +442,8 @@ class GPT2(nn.Module):
         )
         x, _ = stack(cfg, name="blocks")(x, None)
 
-        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_f")(x)
+        x = _norm_cls(cfg)(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           name="ln_f")(x)
         if return_hidden:
             # final hidden states for the fused head+loss path (ops/ce.py);
             # the caller owns the tied-head matmul
@@ -402,7 +486,7 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
         return y
 
     def pipeline_head(other_params, x):
-        ln = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        ln = _norm_cls(cfg)(dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         xn = ln.apply({"params": other_params["ln_f"]}, x)
         logits = jnp.einsum("btd,vd->btv", xn, other_params["wte"].astype(cfg.dtype))
         return logits.astype(jnp.float32)
@@ -479,4 +563,11 @@ def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
 
 def build_gptj(name: str = "gptj-6b", **overrides) -> ModelSpec:
     """GPT-J factory (rotary + parallel residual; reference ``GPTJ.py:271-390``)."""
+    return build_gpt2(name, **overrides)
+
+
+def build_llama(name: str = "llama-1b", **overrides) -> ModelSpec:
+    """Llama-class factory (RMSNorm + SwiGLU + GQA + rotary) — a family the
+    reference zoo never had; every technique works on it because the stack
+    is the same scanned-block ModelSpec contract."""
     return build_gpt2(name, **overrides)

@@ -63,9 +63,9 @@ def tensor_parallel_rules(axis: str = "model"):
     (``Strategy.py:34``).
     """
 
-    col = re.compile(r"(qkv|mlp_in)/kernel$")
+    col = re.compile(r"(qkv|mlp_in|mlp_gate)/kernel$")
     row = re.compile(r"(attn_out|mlp_out)/kernel$")
-    colb = re.compile(r"(qkv|mlp_in)/bias$")
+    colb = re.compile(r"(qkv|mlp_in|mlp_gate)/bias$")
     # Paths are full state paths ('params/wte', 'opt_state/0/mu/wte', ...),
     # so anchor on a path segment, not the whole string.
     vocab = re.compile(r"(^|/)wte$")
