@@ -1,0 +1,119 @@
+"""One-command TPU session: run the chip checklist in priority order.
+
+The axon tunnel can be down for hours and come back without warning
+(round 3 lost its whole measurement window; round 4's tunnel never came
+up). When a window opens, ONE command should capture everything the
+VERDICT asks for, most important first, each step bounded so a mid-run
+hang cannot eat the window:
+
+1. step_variants  — attention x loss x scan-unroll matrix (VERDICT #1)
+2. bench.py       — the headline number + MFU
+3. config_sweeps --config 2 — first on-chip multi-job makespan (VERDICT #3)
+4. billion_scale  — gptj-1b3 under offload stream (VERDICT #4)
+5. memory_contract — predicted-vs-actual HBM rows
+6. longcontext_bench --mode chip — seq-scaling rows
+
+Each step is a subprocess with its own timeout; results and tails land in
+one JSONL (default /tmp/chip_session.jsonl) and stdout. Steps that fail
+or time out are recorded and the session continues. Probes the tunnel
+first (bounded) and exits 2 immediately if it is down.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/chip_session.py
+     [--only step_variants bench] [--log PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEPS = [
+    ("step_variants", [sys.executable, "benchmarks/step_variants.py"], 2400),
+    ("bench", [sys.executable, "bench.py"], 900),
+    ("config2", [sys.executable, "benchmarks/config_sweeps.py",
+                 "--config", "2"], 2400),
+    ("billion_scale", [sys.executable, "benchmarks/billion_scale.py"], 2400),
+    ("memory_contract", [sys.executable, "benchmarks/memory_contract.py"],
+     3600),
+    ("longcontext", [sys.executable, "benchmarks/longcontext_bench.py",
+                     "--mode", "chip"], 2400),
+]
+
+
+def probe(timeout_s: float = 90.0) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print('PLAT='+d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return any(line.startswith("PLAT=") and "cpu" not in line
+                   for line in r.stdout.splitlines())
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log", default="/tmp/chip_session.jsonl")
+    ap.add_argument("--only", nargs="*", default=None,
+                    choices=[n for n, _, _ in STEPS],
+                    help="subset of step names to run, in the given order")
+    ap.add_argument("--skip-probe", action="store_true")
+    args = ap.parse_args()
+
+    if not args.skip_probe and not probe():
+        print("chip_session: tunnel down (probe failed) — aborting",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    steps = STEPS
+    if args.only:
+        by_name = dict((n, (n, c, t)) for n, c, t in STEPS)
+        steps = [by_name[n] for n in args.only]
+
+    with open(args.log, "a") as logf:
+        for name, cmd, budget in steps:
+            t0 = time.time()
+            rec = {"step": name, "cmd": " ".join(cmd), "started": t0}
+            print(f"== chip_session: {name} (budget {budget}s) ==",
+                  flush=True)
+            try:
+                r = subprocess.run(
+                    cmd, cwd=REPO, env=env, capture_output=True, text=True,
+                    timeout=budget,
+                )
+                rec["rc"] = r.returncode
+                rec["tail"] = (r.stdout or "")[-4000:]
+                rec["stderr_tail"] = (r.stderr or "")[-1500:]
+                print(rec["tail"])
+            except subprocess.TimeoutExpired as e:
+                def _tail(stream):
+                    s = stream or b""
+                    if isinstance(s, bytes):
+                        s = s.decode(errors="replace")
+                    return s[-4000:]
+
+                rec["rc"] = "timeout"
+                rec["tail"] = _tail(e.stdout)
+                # stderr carries the diagnostic text (XLA errors, hang
+                # traces) for exactly the steps that need diagnosis
+                rec["stderr_tail"] = _tail(e.stderr)
+                print(f"chip_session: {name} timed out after {budget}s",
+                      file=sys.stderr)
+            rec["wall_s"] = round(time.time() - t0, 1)
+            logf.write(json.dumps(rec) + "\n")
+            logf.flush()
+    print(f"chip_session: done, log at {args.log}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Long-context scaling rows: flash + fused-CE-recompute, ring, ulysses.
+
+SURVEY.md §5 names long context as first-class; the single-chip story is
+flash attention (O(T) memory) + the fused CE's recompute mode (zero O(N,V)
+memory), and the multi-chip story is ring/Ulysses sequence parallelism.
+This bench produces the BASELINE.md scaling table:
+
+- single-chip: GPT-2-small at seq {2k, 4k, 8k, 16k} iso-token (batch
+  shrinks as seq grows), flash + fused CE (stash auto-flips to recompute
+  past STASH_BYTES_MAX) — tokens/s and peak HBM;
+- CPU-mesh (--mode cpu, reduced shapes): ring and ulysses over a
+  (data=1, seq=4) mesh at seq {256, 512} on the tiny preset — mechanism
+  numbers proving the schedule scales, not throughput claims.
+
+Run on TPU:  PYTHONPATH=/root/repo:$PYTHONPATH python \
+    benchmarks/longcontext_bench.py --mode chip
+CPU smoke:   python benchmarks/longcontext_bench.py --mode cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import timeit
+
+
+def _chip_rows(preset: str, seqs, tokens_per_step: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    rows = []
+    for seq in seqs:
+        batch = max(tokens_per_step // seq, 1)
+        spec = build_gpt2(preset, seq_len=seq)
+        ds = make_lm_dataset(
+            context_length=seq, batch_size=batch,
+            vocab_size=spec.config.vocab_size, n_tokens=seq * batch * 4,
+        )
+        tx = optax.adamw(3e-4)
+        loss_of = spec.fused_loss_fn or (
+            lambda p, b: pretraining_loss(spec.apply_fn(p, b), b)
+        )
+
+        def step(state, b):
+            l, g = jax.value_and_grad(loss_of)(state["params"], b)
+            up, opt = tx.update(g, state["opt"], state["params"])
+            return {"params": optax.apply_updates(state["params"], up),
+                    "opt": opt}, l
+
+        jstep = jax.jit(step, donate_argnums=(0,))
+        try:
+            state = jax.jit(
+                lambda: {"params": spec.init_fn(jax.random.PRNGKey(0)),
+                         "opt": tx.init(spec.init_fn(jax.random.PRNGKey(0)))}
+            )()
+            batches = [jnp.asarray(ds.batch(i)) for i in range(2)]
+            for _ in range(2):
+                state, l = jstep(state, batches[0])
+            float(jax.device_get(l))
+            n_timed = 10
+            t0 = timeit.default_timer()
+            for i in range(n_timed):
+                state, l = jstep(state, batches[i % 2])
+            float(jax.device_get(l))
+            dt = (timeit.default_timer() - t0) / n_timed
+            stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
+            rows.append({
+                "seq": seq, "batch": batch,
+                "tokens_per_s": round(batch * seq / dt, 1),
+                "step_s": round(dt, 4),
+                "hbm_peak_gib": round(
+                    stats.get("peak_bytes_in_use", 0) / 2**30, 2),
+            })
+        except Exception as e:  # OOM rows are data, not failures
+            rows.append({"seq": seq, "batch": batch,
+                         "error": type(e).__name__})
+        finally:
+            state = None
+    return rows
+
+
+def _cpu_mesh_rows(seqs):
+    import numpy as np
+
+    import jax
+
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.loss import pretraining_loss
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.parallel.ring import RingSequenceParallel
+    from saturn_tpu.parallel.ulysses import UlyssesSequenceParallel
+
+    devices = jax.devices()[:4]
+    rows = []
+    for mode, tech in (("ring", RingSequenceParallel()),
+                       ("ulysses", UlyssesSequenceParallel())):
+        for seq in seqs:
+            task = Task(
+                get_model=lambda **kw: build_gpt2(
+                    "test-tiny", seq_len=seq, **kw
+                ),
+                get_dataloader=lambda: make_lm_dataset(
+                    context_length=seq, batch_size=2, vocab_size=256,
+                    n_tokens=seq * 2 * 3,
+                ),
+                loss_fn=pretraining_loss,
+                hparams=HParams(lr=1e-3, batch_count=2),
+                save_dir="/tmp/saturn_longctx_ckpts",
+            )
+            bundle = tech.build(task, devices, {"sp": 4, "remat": True})
+            state = bundle.init()
+            b = jax.device_put(task.batch_at(0), bundle.batch_sharding)
+            t0 = timeit.default_timer()
+            state, loss = bundle.step(state, b)
+            lv = float(jax.device_get(loss))
+            dt = timeit.default_timer() - t0
+            assert np.isfinite(lv)
+            rows.append({"mode": mode, "seq": seq, "sp": 4,
+                         "first_step_s": round(dt, 1),
+                         "loss": round(lv, 3)})
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["chip", "cpu"], required=True)
+    ap.add_argument("--preset", default="gpt2-small")
+    ap.add_argument("--tokens-per-step", type=int, default=16384,
+                    help="chip mode: iso-token budget per step (must be >= "
+                         "the largest seq or the table stops being "
+                         "iso-token)")
+    args = ap.parse_args()
+
+    if args.mode == "cpu":
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+            + " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        rows = _cpu_mesh_rows([256, 512])
+    else:
+        rows = _chip_rows(args.preset, [2048, 4096, 8192, 16384],
+                          args.tokens_per_step)
+    print(json.dumps({"metric": "long_context_scaling", "mode": args.mode,
+                      "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()
