@@ -77,10 +77,12 @@ class GPT2Config:
     causal: bool = True
     # Llama-class structure knobs (beyond the reference's GPT-2/GPT-J zoo):
     # RMSNorm instead of LayerNorm, SwiGLU instead of GELU, and
-    # grouped-query attention (n_kv_heads < n_heads; k/v heads are repeated
-    # to n_heads before the attention kernels, so flash/ring/ulysses are
-    # unchanged). n_kv_heads=None keeps the fused 3D qkv projection and
-    # exact param-shape compatibility with every earlier preset.
+    # grouped-query attention (n_kv_heads < n_heads). The flash kernel
+    # takes grouped k/v natively (ops/flash.py — the (B, H, T, D) k/v
+    # expansion never materializes); dense/ring/ulysses see k/v repeated
+    # to n_heads activation-side. n_kv_heads=None keeps the fused 3D qkv
+    # projection and exact param-shape compatibility with every earlier
+    # preset.
     norm: str = "layernorm"          # "layernorm" | "rmsnorm"
     mlp_act: str = "gelu"            # "gelu" | "swiglu"
     n_kv_heads: Optional[int] = None
@@ -291,10 +293,14 @@ class Block(nn.Module):
             sin, cos = rotary_sin_cos(jnp.arange(T) + offset, rd)
             q = apply_rotary(q, sin, cos, rd)
             k = apply_rotary(k, sin, cos, rd)
-        if kv_heads != cfg.n_heads:
-            # GQA: repeat k/v head groups up to n_heads so every attention
-            # path (dense/flash/ring/ulysses) sees matched head counts. The
-            # params stay at kv_heads — the repeat is activation-only.
+        if kv_heads != cfg.n_heads and not (
+            cfg.seq_axis is None and self._attention_impl() == "flash"
+        ):
+            # GQA on the non-flash paths: repeat k/v head groups up to
+            # n_heads so dense/ring/ulysses see matched head counts. The
+            # params stay at kv_heads — the repeat is activation-only. The
+            # flash kernel handles grouped k/v natively (ops/flash.py), so
+            # the expanded activations never exist there.
             rep = cfg.n_heads // kv_heads
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)

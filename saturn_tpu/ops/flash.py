@@ -110,8 +110,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = (m_scr[:, 0] + jnp.log(l))[:, None]
 
 
-def _fwd(q, k, v, *, block_q, block_k, scale, causal):
+def _kv_of(h: int, kv: int):
+    """Flat (B*H) q-head program index -> flat (B*KV) k/v row.
+
+    Grouped-query attention: ``rep = h // kv`` consecutive q heads share
+    one k/v head, so the k/v BlockSpec index maps a q-head grid step to its
+    group's row — the kernels never see repeated k/v and the (B, H, T, D)
+    activation expansion never materializes. rep == 1 is the identity."""
+    rep = h // kv
+
+    def to_kv(bh):
+        return (bh // h) * kv + (bh % h) // rep
+
+    return to_kv
+
+
+def _fwd(q, k, v, *, block_q, block_k, scale, causal, h, kv):
     BH, T, D = q.shape
+    kv_of = _kv_of(h, kv)
     grid = (BH, T // block_q, T // block_k)
     o, lse = pl.pallas_call(
         functools.partial(
@@ -121,8 +137,10 @@ def _fwd(q, k, v, *, block_q, block_k, scale, causal):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, i, j: (kv_of(bh), j, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, i, j: (kv_of(bh), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
@@ -179,10 +197,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, block_q, block_k, scale,
                 causal):
-    jk, iq = pl.program_id(1), pl.program_id(2)
-    n_q = pl.num_programs(2)
+    # Grid (bkv, jk, g, iq): g walks the q heads sharing this k/v head
+    # (size 1 without GQA); the (bkv, jk) output block stays resident across
+    # the whole inner (g, iq) sweep, so dk/dv accumulate the group sum the
+    # transpose of the activation-side repeat would otherwise need.
+    jk, g, iq = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+    n_g, n_q = pl.num_programs(2), pl.num_programs(3)
 
-    @pl.when(iq == 0)
+    @pl.when(jnp.logical_and(g == 0, iq == 0))
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -208,15 +230,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # ds·q is unscaled; the scale factor lands in the finalize below.
         dk_scr[:] = dk_scr[:] + _dot(ds, qb, ((0,), (0,)))
 
-    @pl.when(iq == n_q - 1)
+    @pl.when(jnp.logical_and(g == n_g - 1, iq == n_q - 1))
     def _finalize():
         dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(block_q, block_k, scale, causal, res, do):
+def _bwd(block_q, block_k, scale, causal, h, kv, res, do):
     q, k, v, o, lse = res
     BH, T, D = q.shape
+    BKV = k.shape[0]
+    rep = h // kv
+    kv_of = _kv_of(h, kv)
     # (BH, T, 1) like lse — see the fwd finalize note on Mosaic block rules.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
@@ -230,8 +255,10 @@ def _bwd(block_q, block_k, scale, causal, res, do):
         grid=(BH, T // block_q, T // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, i, j: (kv_of(bh), j, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, i, j: (kv_of(bh), j, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
@@ -242,27 +269,35 @@ def _bwd(block_q, block_k, scale, causal, res, do):
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
 
+    def qh(bkv, g):
+        # flat (B*KV) k/v row + group member -> flat (B*H) q-head row
+        return (bkv // kv) * h + (bkv % kv) * rep + g
+
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, block_q=block_q, block_k=block_k, scale=scale,
             causal=causal,
         ),
-        grid=(BH, T // block_k, T // block_q),
+        grid=(BKV, T // block_k, rep, T // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda bkv, j, g, i: (qh(bkv, g), i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bkv, j, g, i: (bkv, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bkv, j, g, i: (bkv, j, 0)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda bkv, j, g, i: (qh(bkv, g), i, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bkv, j, g, i: (qh(bkv, g), i, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bkv, j, g, i: (qh(bkv, g), i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bkv, j, g, i: (bkv, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bkv, j, g, i: (bkv, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+            jax.ShapeDtypeStruct((BKV, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BKV, T, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -274,24 +309,24 @@ def _bwd(block_q, block_k, scale, causal, res, do):
 
 
 # ---------------------------------------------------------------- public
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bh(q, k, v, block_q, block_k, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bh(q, k, v, block_q, block_k, causal, h, kv):
     scale = 1.0 / math.sqrt(q.shape[-1])
     o, _ = _fwd(q, k, v, block_q=block_q, block_k=block_k, scale=scale,
-                causal=causal)
+                causal=causal, h=h, kv=kv)
     return o
 
 
-def _flash_bh_fwd(q, k, v, block_q, block_k, causal):
+def _flash_bh_fwd(q, k, v, block_q, block_k, causal, h, kv):
     scale = 1.0 / math.sqrt(q.shape[-1])
     o, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k, scale=scale,
-                  causal=causal)
+                  causal=causal, h=h, kv=kv)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bh_bwd(block_q, block_k, causal, res, do):
+def _flash_bh_bwd(block_q, block_k, causal, h, kv, res, do):
     scale = 1.0 / math.sqrt(res[0].shape[-1])
-    return _bwd(block_q, block_k, scale, causal, res, do)
+    return _bwd(block_q, block_k, scale, causal, h, kv, res, do)
 
 
 _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
@@ -342,17 +377,30 @@ def flash_attention(
 ) -> jax.Array:
     """Fused causal attention over (B, H, T, D); differentiable.
 
-    T must divide by the block sizes (defaults: min(128, T)) or this raises —
+    Grouped-query attention is native: ``k``/``v`` may carry fewer heads
+    (B, KV, T, D) with KV dividing H — the kernels index each q head's
+    group row directly, so the (B, H, T, D) k/v expansion (and its HBM at
+    long context) never exists, and dk/dv come back at (B, KV, T, D) with
+    the group sum done in-kernel.
+
+    T must divide by the block sizes (default: the largest of 512/256/128
+    dividing T, else min(128, T) — see ``_default_block``) or this raises —
     the model config validates the constraint up front
     (``GPT2Config.__post_init__``); this op stays strict.
     """
     B, H, T, D = q.shape
+    KV = k.shape[1]
+    if v.shape[1] != KV or KV < 1 or H % KV != 0:
+        raise ValueError(
+            f"k/v heads ({k.shape[1]}, {v.shape[1]}) must match and divide "
+            f"q heads ({H})"
+        )
     bq = block_q or _default_block(T)
     bk = block_k or _default_block(T)
     if T % bq or T % bk:
         raise ValueError(f"seq len {T} not divisible by blocks ({bq}, {bk})")
     qf = q.reshape(B * H, T, D)
-    kf = k.reshape(B * H, T, D)
-    vf = v.reshape(B * H, T, D)
-    o = _flash_bh(qf, kf, vf, bq, bk, causal)
+    kf = k.reshape(B * KV, T, D)
+    vf = v.reshape(B * KV, T, D)
+    o = _flash_bh(qf, kf, vf, bq, bk, causal, H, KV)
     return o.reshape(B, H, T, D)
