@@ -67,6 +67,66 @@ def forecast(
     return relevant, batches, completed
 
 
+def _check_disjoint(run_tasks, plan) -> None:
+    """Device-race + deadlock guard for the gang launch. The MILP's plans
+    satisfy both properties by construction; a hand-built or corrupted plan
+    that violates them would either run two XLA programs on the same chips
+    concurrently (silent corruption, not a crash) or park launcher threads
+    on events that never fire (silent hang) — the engine refuses loudly
+    instead (SURVEY §5 concurrency-safety: detection, not just avoidance).
+
+    - Two launched tasks may share devices only if the dependency graph
+      serializes them — TRANSITIVELY: the launcher's event-waits chain, so
+      a→b→c serializes (a, c) without a direct edge.
+    - The dependency graph restricted to launched tasks must be acyclic:
+      the launcher only waits on running tasks, and a cycle parks every
+      thread in it forever.
+    """
+    running = {t.name for t in run_tasks}
+    deps = {
+        n: [d for d in plan.dependencies.get(n, ()) if d in running]
+        for n in running
+    }
+
+    # Reachability over the running-task dependency DAG; cycle check rides
+    # the same DFS (a node reaching itself).
+    reach: Dict[str, set] = {}
+
+    def reachable(n: str) -> set:
+        if n in reach:
+            return reach[n]
+        reach[n] = set()  # placeholder breaks self-recursion on cycles
+        out = set()
+        for d in deps[n]:
+            out.add(d)
+            out |= reachable(d)
+        reach[n] = out
+        return out
+
+    for n in running:
+        if n in reachable(n):
+            raise RuntimeError(
+                f"plan dependency cycle through task {n!r}: the gang "
+                "launch would deadlock (every thread in the cycle waits "
+                "on another's completion event)"
+            )
+
+    items = [(t.name, plan.assignments.get(t.name)) for t in run_tasks]
+    for i, (n1, a1) in enumerate(items):
+        if a1 is None:
+            continue
+        for n2, a2 in items[i + 1:]:
+            if a2 is None or not a1.block.overlaps(a2.block):
+                continue
+            if n1 not in reachable(n2) and n2 not in reachable(n1):
+                raise RuntimeError(
+                    f"plan races tasks {n1!r} and {n2!r}: blocks "
+                    f"[{a1.block.offset}:{a1.block.end}] and "
+                    f"[{a2.block.offset}:{a2.block.end}] overlap with no "
+                    "ordering path between them"
+                )
+
+
 def execute(
     run_tasks: Sequence,
     batches: Dict[str, int],
@@ -94,6 +154,8 @@ def execute(
     if distributed.is_multihost():
         return _execute_multihost(run_tasks, batches, interval, plan,
                                   topology, failure_policy)
+
+    _check_disjoint(run_tasks, plan)
 
     events = {t.name: threading.Event() for t in run_tasks}
     running = {t.name for t in run_tasks}
