@@ -285,3 +285,37 @@ class TestAsyncCheckpoint:
             ckpt.flush()
         # the error is consumed; later flushes are clean
         ckpt.flush()
+
+
+class TestWriterRank:
+    """Multi-host checkpoint writer selection (utils/checkpoint._writer_rank):
+    the lowest process index addressing the tree writes it."""
+
+    def test_host_trees_default_to_rank0(self):
+        import numpy as np
+
+        from saturn_tpu.utils.checkpoint import _writer_rank
+
+        assert _writer_rank({"a": np.ones(3)}) == 0
+
+    def test_device_tree_uses_lowest_addressing_process(self):
+        import numpy as np
+
+        from saturn_tpu.utils.checkpoint import _writer_rank
+
+        class FakeDev:
+            def __init__(self, pi):
+                self.process_index = pi
+
+        class FakeSharding:
+            def __init__(self, pis):
+                self.device_set = {FakeDev(p) for p in pis}
+
+        class FakeLeaf:
+            def __init__(self, pis):
+                self.sharding = FakeSharding(pis)
+
+        assert _writer_rank({"w": FakeLeaf([2, 3])}) == 2
+        assert _writer_rank({"w": FakeLeaf([0, 1, 2])}) == 0
+        # host (no-sharding) leaves are skipped in favor of device leaves
+        assert _writer_rank({"a": np.ones(2), "w": FakeLeaf([1])}) == 1

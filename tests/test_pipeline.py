@@ -86,6 +86,22 @@ def test_balance_stages_beats_even_split():
     assert spans == (2, 4)
 
 
+def test_balance_stages_never_worse_than_even(seed_count=30):
+    """Property: on random cost vectors the DP's bottleneck cost is <= the
+    even split's (when an even split exists), and spans always partition."""
+    from saturn_tpu.ops.pipeline import balance_stages
+
+    rng = np.random.default_rng(11)
+    for _ in range(seed_count):
+        S = int(rng.integers(2, 5))
+        L = S * int(rng.integers(1, 5))
+        costs = rng.uniform(0.5, 10.0, size=L).tolist()
+        spans = balance_stages(costs, S)
+        assert len(spans) == S and sum(spans) == L and min(spans) >= 1
+        even = (L // S,) * S
+        assert _span_maxcost(costs, spans) <= _span_maxcost(costs, even) + 1e-9
+
+
 def test_balance_stages_uniform_indivisible():
     from saturn_tpu.ops.pipeline import balance_stages
 
