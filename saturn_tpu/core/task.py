@@ -209,12 +209,17 @@ class Task:
         thread is reading strategy state (the orchestrator calls it after
         joining the overlapped re-solve).
 
-        Sibling strategies are scaled by the same correction ratio:
-        estimate error is dominated by systemic effects (contention, shape
-        mis-profiling) that hit every apportionment alike, and correcting
-        only the executed one would make the re-solve ping-pong to whichever
-        sibling still carries its optimistic trial profile. A sibling's own
-        execution later re-corrects it from its own measurement."""
+        Sibling strategies are corrected too: estimate error is dominated by
+        systemic effects (contention, shape mis-profiling) that hit every
+        apportionment alike, and correcting only the executed one would make
+        the re-solve ping-pong to whichever sibling still carries its
+        optimistic trial profile. To keep alternating re-solves from
+        cross-multiplying strategy-specific errors without bound, the
+        correction is *replaced, not compounded*: each never-executed sibling
+        is set to ``trial_profile x (executed_now / executed_trial)`` —
+        anchored to both strategies' original trial profiles — and a sibling
+        that has ever produced its own measurement is left alone (its own
+        EWMA is better evidence than a cross-strategy ratio)."""
         pending = getattr(self, "_pending_realized", None)
         self._pending_realized = None
         if pending is None:
@@ -222,17 +227,29 @@ class Task:
         strat, realized = pending
         if not strat.feasible:
             return None
+        # Stash every strategy's original trial profile on first feedback so
+        # sibling corrections stay anchored to it forever after.
+        for s in self.strategies.values():
+            if s.feasible and getattr(s, "_trial_per_batch", None) is None:
+                s._trial_per_batch = s.per_batch_time
         old = strat.per_batch_time
         strat.per_batch_time = (
             self.EWMA_ALPHA * realized + (1.0 - self.EWMA_ALPHA) * old
             if old > 0.0 else realized
         )
+        strat._self_measured = True
         strat.runtime = strat.per_batch_time * max(self.total_batches, 0)
-        if old > 0.0:
-            ratio = strat.per_batch_time / old
+        trial_base = getattr(strat, "_trial_per_batch", 0.0) or 0.0
+        if trial_base > 0.0:
+            cum_ratio = strat.per_batch_time / trial_base
             for s in self.strategies.values():
-                if s is not strat and s.feasible and s.per_batch_time > 0.0:
-                    s.per_batch_time *= ratio
+                if (
+                    s is not strat
+                    and s.feasible
+                    and not getattr(s, "_self_measured", False)
+                    and (getattr(s, "_trial_per_batch", 0.0) or 0.0) > 0.0
+                ):
+                    s.per_batch_time = s._trial_per_batch * cum_ratio
                     s.runtime = s.per_batch_time * max(self.total_batches, 0)
         return old, strat.per_batch_time
 

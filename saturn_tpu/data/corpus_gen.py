@@ -63,14 +63,45 @@ def generate_corpus(
     """Write ~``size_mb`` MB of synthetic text to ``out_path``.
 
     Returns {"bytes", "tokens", "types"}. Skips generation if the file
-    already exists at >= the requested size (idempotent for benchmarks).
+    already exists at >= the requested size AND a sidecar ``.meta.json``
+    records the same generation parameters — a corpus written with a
+    different seed / seed_path / n_extra_types is regenerated, not silently
+    reused (size alone can't tell them apart). On reuse the sidecar's
+    token/type counts are returned so benchmark metadata never sees None.
     """
+    import json
+
     target = int(size_mb * 1e6)
+    meta_path = out_path + ".meta.json"
+    params = {
+        "seed_path": os.path.abspath(seed_path or _DEFAULT_SEED_TEXT),
+        "n_extra_types": int(n_extra_types),
+        "seed": int(seed),
+    }
     # The byte count is estimated from mean word length, so the written
     # size lands within a few percent of target; treat >= 90% as done.
     if os.path.exists(out_path) and os.path.getsize(out_path) >= 0.9 * target:
-        return {"bytes": os.path.getsize(out_path), "tokens": None,
-                "types": None}
+        meta = None
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if meta is not None and meta.get("params") == params:
+            return {"bytes": os.path.getsize(out_path),
+                    "tokens": meta.get("tokens"), "types": meta.get("types"),
+                    "reused": True}
+        log_msg = ("existing corpus %s has %s generation parameters — "
+                   "regenerating" % (out_path,
+                                     "different" if meta else "unknown"))
+        print(f"corpus_gen: {log_msg}")
+    # Invalidate the sidecar BEFORE rewriting the body: an interrupted
+    # regeneration must not leave a new-params body paired with old-params
+    # metadata (a later call would silently reuse the wrong corpus).
+    try:
+        os.remove(meta_path)
+    except OSError:
+        pass
     types, p = _seed_distribution(seed_path or _DEFAULT_SEED_TEXT,
                                   n_extra_types)
     mean_len = float((np.char.str_len(types) * p).sum())
@@ -94,6 +125,9 @@ def generate_corpus(
             f.write(s)
             written += len(s)
             total_toks += m
+    with open(meta_path, "w") as f:
+        json.dump({"params": params, "tokens": total_toks,
+                   "types": len(types)}, f)
     return {"bytes": written, "tokens": total_toks, "types": len(types)}
 
 

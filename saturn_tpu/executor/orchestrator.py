@@ -181,12 +181,28 @@ def _orchestrate_loop(
 
                 if multihost and remaining:
                     # Every rank must reach this broadcast; the coordinator
-                    # contributes its joined re-solve.
-                    new_plan = future.result().to_json() if future else None
+                    # contributes its joined re-solve. A coordinator-side
+                    # solve failure must still be broadcast — as an error
+                    # sentinel every rank raises on — or the other ranks
+                    # block inside broadcast_json until the distributed
+                    # failure detector fires (opaque cluster hang; same
+                    # fail-fast rationale as engine._execute_multihost).
+                    new_plan = None
+                    if future is not None:
+                        try:
+                            new_plan = future.result().to_json()
+                        except Exception as e:
+                            new_plan = {
+                                "__solve_error__": f"{type(e).__name__}: {e}"
+                            }
                     future = None
-                    plan = milp.Plan.from_json(
-                        distributed.broadcast_json(new_plan)
-                    )
+                    payload = distributed.broadcast_json(new_plan)
+                    if isinstance(payload, dict) and "__solve_error__" in payload:
+                        raise RuntimeError(
+                            "re-solve failed on coordinator: "
+                            + payload["__solve_error__"]
+                        )
+                    plan = milp.Plan.from_json(payload)
                     logger.info("re-solve: makespan %.1fs", plan.makespan)
                     metrics.event("solve", makespan_s=plan.makespan,
                                   n_tasks=len(remaining))

@@ -16,7 +16,9 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <map>
 #include <mutex>
+#include <utility>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -159,7 +161,14 @@ bool build_encoded(const char* path, int max_vocab, Encoded& out) {
 }
 
 std::mutex g_cache_mu;
-Encoded g_cache;
+// Cache keyed per (path, max_vocab): interleaved count/fill call pairs for
+// different corpora (or vocab caps) must not invalidate each other — the
+// single-slot version silently reverted to two full builds per encode in
+// exactly that pattern. Entries are erased on fill, so only unpaired count
+// calls linger; the size cap bounds worst-case resident id streams
+// (~4 B/token each) if a caller counts many corpora and never fills.
+constexpr size_t kCacheCap = 4;
+std::map<std::pair<std::string, int>, Encoded> g_cache;
 
 }  // namespace
 
@@ -174,40 +183,68 @@ long word_tokenize_file(const char* path, int max_vocab,
   if (!path || max_vocab < 3) return -2;
   if (out_ids && out_capacity < 0) return -2;  // memcpy below must not
   //                                              underflow to a huge size_t
-  std::lock_guard<std::mutex> lock(g_cache_mu);
   // The Python wrapper calls count (out_ids == NULL) then fill; the cache
-  // makes the pair cost ONE build. Keyed on (path, max_vocab, file size,
-  // file mtime) so a corpus rewritten between an unpaired count call and a
-  // later call re-builds — size alone misses same-length rewrites; the
-  // fill call releases the cached memory either way.
+  // makes the pair cost ONE build. Freshness is checked on (file size,
+  // mtime) so a corpus rewritten between an unpaired count call and a
+  // later call re-builds — size alone misses same-length rewrites.
   long cur_size;
   double cur_mtime;
   stat_file(path, &cur_size, &cur_mtime);
-  if (!(g_cache.valid && g_cache.path == path &&
-        g_cache.max_vocab == max_vocab && g_cache.file_size == cur_size &&
-        g_cache.file_mtime == cur_mtime)) {
-    g_cache.valid = false;
-    if (!build_encoded(path, max_vocab, g_cache)) return -1;
-    g_cache.file_size = cur_size;
-    g_cache.file_mtime = cur_mtime;
+  const std::pair<std::string, int> key{path, max_vocab};
+
+  Encoded local;
+  Encoded* enc = nullptr;
+  {
+    std::lock_guard<std::mutex> lock(g_cache_mu);
+    auto it = g_cache.find(key);
+    if (it != g_cache.end()) {
+      if (it->second.valid && it->second.file_size == cur_size &&
+          it->second.file_mtime == cur_mtime) {
+        if (!out_ids) return static_cast<long>(it->second.ids.size());
+        // Fill call: take ownership so the entry frees on return and the
+        // build below never runs.
+        local = std::move(it->second);
+        g_cache.erase(it);
+        enc = &local;
+      } else {
+        // Stale (corpus rewritten since the count call): free the old
+        // ~4 B/token stream now, not at process exit.
+        g_cache.erase(it);
+      }
+    }
   }
-  const long n = static_cast<long>(g_cache.ids.size());
+  if (!enc) {
+    // Build OUTSIDE the lock: concurrent encodes of unrelated corpora must
+    // not serialize behind each other's multi-second builds. Two threads
+    // racing on the SAME key both build; the insert below keeps one.
+    if (!build_encoded(path, max_vocab, local)) return -1;
+    local.file_size = cur_size;
+    local.file_mtime = cur_mtime;
+    enc = &local;
+    if (!out_ids) {
+      const long n = static_cast<long>(local.ids.size());
+      std::lock_guard<std::mutex> lock(g_cache_mu);
+      if (g_cache.size() >= kCacheCap) g_cache.erase(g_cache.begin());
+      g_cache[key] = std::move(local);
+      return n;
+    }
+  }
+  const long n = static_cast<long>(enc->ids.size());
   if (!out_ids) return n;
 
-  if (out_vocab_size) *out_vocab_size = g_cache.vocab_size;
+  if (out_vocab_size) *out_vocab_size = enc->vocab_size;
   if (vocab_out_path && vocab_out_path[0]) {
     FILE* vf = std::fopen(vocab_out_path, "wb");
     if (vf) {
       std::fputs("<pad>\n<unk>\n", vf);
-      for (const auto& w : g_cache.words)
+      for (const auto& w : enc->words)
         std::fprintf(vf, "%s\n", w.c_str());
       std::fclose(vf);
     }
   }
   const long m = std::min(n, out_capacity);
-  std::memcpy(out_ids, g_cache.ids.data(), sizeof(int32_t) * m);
-  g_cache = Encoded();  // free the ~4B/token stream eagerly
-  return n;
+  std::memcpy(out_ids, enc->ids.data(), sizeof(int32_t) * m);
+  return n;  // `local` frees the ~4B/token stream on return
 }
 
 }  // extern "C"

@@ -446,8 +446,12 @@ def fused_linear_cross_entropy(
     # fwd/dx: wide token blocks, narrow vocab blocks; dW: the transpose.
     # Sized so every kernel's VMEM residency (score block, accumulators,
     # double-buffered streams) stays under the ~16 MiB scoped-vmem limit up
-    # to d_model 4096 (gptj-6b): bn*D*2B (x block) ≲ 4 MiB.
-    bn_cap = max(2 * 1024 * 1024 // max(D, 1), 128)  # 2048 @ D<=1024, 512 @ 4096
+    # to d_model 4096 (gptj-6b). The round-5 chip run measured the stash-mode
+    # fwd at bn=2048/bv=512/D=768 at 17.18 MiB (double-buffered x + stash
+    # streams + f32 score block + exp temp) — 1.18 MiB over. One bf16 byte-
+    # pair of token-block per D column (bn*D*2B <= 2 MiB) is the budget that
+    # fits every kernel with ~35% headroom.
+    bn_cap = max((1 << 20) // max(D, 1), 128)  # 1024 @ D<=1024, 256 @ 4096
     bn = block_n or _pick_block(
         N, tuple(b for b in (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
                  if b <= bn_cap)

@@ -14,6 +14,7 @@ override only the three small hooks at the bottom.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import timeit as _timeit
 from dataclasses import dataclass
@@ -31,6 +32,11 @@ from saturn_tpu.utils import checkpoint as ckpt
 from saturn_tpu.utils.timing import device_hbm_bytes, hbm_bytes_required, time_train_step
 
 log = logging.getLogger("saturn_tpu")
+
+
+def _stage_to_device(tree):
+    """Move a (possibly pinned-host) tree into device memory inside jit."""
+    return jax.device_put(tree, jax.memory.Space.Device)
 
 
 @dataclass
@@ -146,15 +152,37 @@ class SPMDTechnique(BaseTechnique):
         schedule (pipeline) override this to build a ``shard_map`` step;
         techniques that only change the forward pass (offload streaming)
         override via ``step_fns_from_forward``.
+
+        When the technique pins persistent state to host memory
+        (``param_memory_kind == 'pinned_host'`` — fsdp's offload grid, bulk
+        offload), TPU compute cannot consume the host-space arrays directly
+        (round-5 chip run: ``add`` of f32 and f32<host> is rejected), so the
+        forward stages params to device and the optimizer update runs as
+        host computation — see ``step_fns_from_loss_and_grads``.
         """
+        to_host_update = self.param_memory_kind(config) == "pinned_host"
+        forward = spec.apply_fn
+        forward_with_aux = None
+        if to_host_update:
+            def forward(params, batch):
+                return spec.apply_fn(_stage_to_device(params), batch)
+
+            if spec.apply_with_aux_fn is not None:
+                def forward_with_aux(params, batch):
+                    return spec.apply_with_aux_fn(
+                        _stage_to_device(params), batch
+                    )
+
         return self.step_fns_from_forward(
-            spec, task, spec.apply_fn, mesh=mesh,
-            batch_partition=self.batch_spec(config),
+            spec, task, forward, forward_with_aux=forward_with_aux,
+            mesh=mesh, batch_partition=self.batch_spec(config),
+            update_on_host=to_host_update,
         )
 
     def step_fns_from_forward(
         self, spec: Any, task: Any, forward: Any, forward_with_aux: Any = None,
         mesh: Any = None, batch_partition: Any = None,
+        update_on_host: bool = False,
     ) -> Tuple[Any, Any]:
         """Standard loss/grad/optax scaffold around ``forward(params, batch)``.
 
@@ -226,7 +254,8 @@ class SPMDTechnique(BaseTechnique):
                 return jax.value_and_grad(fused_loss)(params, batch)
 
             return self.step_fns_from_loss_and_grads(
-                spec.init_fn, task, loss_and_grads
+                spec.init_fn, task, loss_and_grads,
+                update_on_host=update_on_host,
             )
 
         def loss_and_grads(params, batch):
@@ -238,7 +267,9 @@ class SPMDTechnique(BaseTechnique):
 
             return jax.value_and_grad(loss_of)(params)
 
-        return self.step_fns_from_loss_and_grads(spec.init_fn, task, loss_and_grads)
+        return self.step_fns_from_loss_and_grads(
+            spec.init_fn, task, loss_and_grads, update_on_host=update_on_host
+        )
 
     @staticmethod
     def _aux_incompatible(spec: Any) -> bool:
@@ -261,7 +292,8 @@ class SPMDTechnique(BaseTechnique):
             )
 
     def step_fns_from_loss_and_grads(
-        self, init_params: Any, task: Any, loss_and_grads: Any
+        self, init_params: Any, task: Any, loss_and_grads: Any,
+        update_on_host: bool = False,
     ) -> Tuple[Any, Any]:
         """(init_state, train_step) around ``loss_and_grads(params, batch)``.
 
@@ -269,6 +301,15 @@ class SPMDTechnique(BaseTechnique):
         step}) and the optimizer-update tail — every technique (dense,
         offload, pipeline, ring) routes through here so the state contract
         cannot diverge between them.
+
+        ``update_on_host``: run the (elementwise) optax update as XLA host
+        computation against the pinned-host state. This is what lets
+        billion-param offload fit: params + both adam moments never occupy
+        HBM at once — only the grads cross PCIe (ZeRO-Offload's CPU-optimizer
+        design, the TPU-native analog of the reference's fairscale spilling,
+        ``Spilled.py:23-28``). Staging the update to device instead would
+        put 4 copies (params, grads, mu, nu) on chip and OOM the very
+        models the technique exists for.
         """
         tx = task.hparams.make_optimizer()
 
@@ -282,12 +323,23 @@ class SPMDTechnique(BaseTechnique):
 
         def train_step(state, batch):
             loss, grads = loss_and_grads(state["params"], batch)
-            updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
-            new_params = optax.apply_updates(state["params"], updates)
+            if update_on_host:
+                from jax.experimental.compute_on import compute_on
+
+                grads = jax.device_put(grads, jax.memory.Space.Host)
+                ctx = compute_on("device_host")
+            else:
+                ctx = contextlib.nullcontext()
+            with ctx:
+                updates, new_opt = tx.update(
+                    grads, state["opt_state"], state["params"]
+                )
+                new_params = optax.apply_updates(state["params"], updates)
+                new_step = state["step"] + 1
             return {
                 "params": new_params,
                 "opt_state": new_opt,
-                "step": state["step"] + 1,
+                "step": new_step,
             }, loss
 
         return init_state, train_step
@@ -493,7 +545,8 @@ class SPMDTechnique(BaseTechnique):
 
         start = task.current_batch
         loss = None
-        t0 = _timeit.default_timer()
+        t_all0 = _timeit.default_timer()
+        t_steady = t_all0
         for i in range(n):
             # put_global == device_put single-process; on a multi-host
             # block each process's devices take their slice locally
@@ -501,24 +554,40 @@ class SPMDTechnique(BaseTechnique):
                 task.batch_at(start + i), bundle.batch_sharding
             )
             state, loss = bundle.compiled(state, batch)
+            if i == 0 and n > 1:
+                # The first step pays the one-time jit compile whenever this
+                # bundle wasn't pre-warmed by search (preset-strategy /
+                # multi-host flows, every re-solve that moves a task to a new
+                # block). Keep it out of the realized-feedback window: block
+                # on its result and restart the steady-state timer.
+                jax.block_until_ready(loss)
+                t_steady = _timeit.default_timer()
         if loss is not None:
             # host read = reliable queue drain (see utils/timing.py note)
             loss_val = _dist.host_scalar(loss)
-            elapsed = _timeit.default_timer() - t0
+            t_end = _timeit.default_timer()
+            elapsed_all = t_end - t_all0
             bs = task.get_dataset().batch_size
-            sps = n * bs / max(elapsed, 1e-9)
+            sps = n * bs / max(elapsed_all, 1e-9)
             # per-job samples/sec — the BASELINE.md per-job metric — and the
             # realized per-batch time (vs the profiled estimate forecast used)
             task.last_samples_per_sec = sps
-            # feed the profiled-vs-realized loop: the orchestrator folds this
-            # into the executed strategy after joining the overlapped solve
-            task.note_realized_per_batch(elapsed / n)
+            if n > 1:
+                # feed the profiled-vs-realized loop from the steady-state
+                # window only (batches 2..n); a compile-dominated first
+                # interval would otherwise inflate the EWMA many-fold and
+                # propagate to every sibling strategy. n == 1 gives no
+                # compile-free sample, so no feedback is noted.
+                per_batch = (t_end - t_steady) / (n - 1)
+                task.note_realized_per_batch(per_batch)
+            else:
+                per_batch = elapsed_all
             from saturn_tpu.utils import metrics as _metrics
 
             _metrics.event(
                 "task_interval", task=task.name, technique=self.name,
                 batches=n, loss=loss_val, samples_per_sec=round(sps, 2),
-                per_batch_s=elapsed / n,
+                per_batch_s=per_batch,
             )
             log.info("task %s [%s]: ran %d batches, loss %.4f, %.1f samples/s",
                      task.name, self.name, n, loss_val, sps)

@@ -303,6 +303,47 @@ class TestEstimateFeedback:
             assert s.per_batch_time == pytest.approx(1.0 * ratio)
             assert s.runtime == pytest.approx(s.per_batch_time * 10)
 
+    def test_alternating_strategies_do_not_compound(self):
+        """ADVICE r4: if the re-solve alternates between two strategies with
+        strategy-specific (not systemic) errors, cross-corrections must not
+        multiply without bound. Anchored replacement keeps each sibling at
+        trial_profile x (executed_now / executed_trial), and a strategy
+        that has its own measurement is never overwritten by a sibling's."""
+        tech = RecordingTech()
+        t = FakeTask("a", total_batches=10, sizes=[2, 4], tech=tech, pbt=1.0)
+        # Strategy 4 truly runs at 2.0, strategy 2 truly runs at 1.0:
+        # alternate executions many times; under compounding the estimates
+        # diverge geometrically, under anchoring they stay bounded.
+        for _ in range(6):
+            t.select_strategy(4)
+            t.note_realized_per_batch(2.0)
+            t.apply_realized_feedback()
+            t.select_strategy(2)
+            t.note_realized_per_batch(1.0)
+            t.apply_realized_feedback()
+        # Each converges to its own realized time (both self-measured, so
+        # neither is rescaled by the other's ratio after its first run).
+        assert abs(t.strategies[4].per_batch_time - 2.0) < 0.05
+        assert abs(t.strategies[2].per_batch_time - 1.0) < 0.05
+
+    def test_never_executed_sibling_tracks_cumulative_ratio(self):
+        """A sibling with no measurement of its own follows the executed
+        strategy's *cumulative* correction vs its trial profile — replaced
+        each time, not compounded across intervals."""
+        tech = RecordingTech()
+        t = FakeTask("a", total_batches=10, sizes=[2, 4], tech=tech, pbt=1.0)
+        t.select_strategy(4)
+        for _ in range(5):
+            t.note_realized_per_batch(3.0)
+            t.apply_realized_feedback()
+        s4 = t.strategies[4]
+        # executed strategy EWMA-converges to 3.0; sibling = trial x ratio
+        expected_sibling = 1.0 * (s4.per_batch_time / 1.0)
+        assert t.strategies[2].per_batch_time == pytest.approx(
+            expected_sibling
+        )
+        assert t.strategies[2].per_batch_time < 3.5  # bounded, not 3^5
+
     def test_note_is_consumed_once(self):
         t = FakeTask("a", 10, [4], RecordingTech(), pbt=2.0)
         t.select_strategy(4)

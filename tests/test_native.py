@@ -202,6 +202,63 @@ class TestNativeTokenizer:
         assert va == vb
 
 
+class TestInterleavedEncodeCache:
+    """ADVICE r4: the native encode cache is keyed per (path, max_vocab) —
+    interleaved count/fill call pairs for different corpora (or vocab caps)
+    must each hit their own cached build and return correct streams."""
+
+    def test_interleaved_corpora_and_vocab_caps(self, tmp_path):
+        import ctypes
+
+        from saturn_tpu import native
+
+        lib = native.load("tokenize")
+        if lib is None:
+            pytest.skip("native tokenize unavailable")
+        fn = lib.word_tokenize_file
+        fn.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        fn.restype = ctypes.c_long
+
+        pa = tmp_path / "a.txt"
+        pb = tmp_path / "b.txt"
+        pa.write_text("alpha beta gamma alpha beta alpha\n" * 50)
+        pb.write_text("delta epsilon delta zeta eta theta iota\n" * 50)
+
+        def count(p, mv):
+            return fn(str(p).encode(), mv, None, None, 0, None)
+
+        def fill(p, mv, n):
+            ids = np.empty(n, dtype=np.int32)
+            vs = ctypes.c_int()
+            got = fn(
+                str(p).encode(), mv, None,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n, ctypes.byref(vs),
+            )
+            assert got == n
+            return ids, vs.value
+
+        # Interleave: count(a), count(b), count(a@small-vocab), then fill
+        # all three — every pair must resolve from its own cache entry.
+        na = count(pa, 64)
+        nb = count(pb, 64)
+        na_small = count(pa, 4)
+        assert na == na_small == 50 * 6 and nb == 50 * 7
+        ids_b, vs_b = fill(pb, 64, nb)
+        ids_a, vs_a = fill(pa, 64, na)
+        ids_a4, vs_a4 = fill(pa, 4, na_small)
+        assert vs_a == 5 and vs_b == 8  # distinct words + pad/unk
+        assert vs_a4 == 4
+        assert (ids_a4 == 1).any()  # capped vocab -> <unk> pressure
+        assert ids_a.max() < vs_a and ids_b.max() < vs_b
+        # id streams differ between the corpora (cache didn't cross wires)
+        assert len(ids_a) != len(ids_b) or (ids_a[: len(ids_b)] != ids_b).any()
+
+
 class TestCorpusGen:
     """WikiText-scale corpus synthesis (data/corpus_gen.py) — small sizes
     here; benchmarks/tokenizer_bench.py runs the 100MB+ flow."""
@@ -223,9 +280,28 @@ class TestCorpusGen:
         generate_corpus(b, size_mb=0.2, n_extra_types=500, seed=7)
         with open(a) as fa, open(b) as fb:
             assert fa.read() == fb.read()
-        # second call on an existing big-enough file skips regeneration
+        # second call on an existing big-enough file skips regeneration and
+        # reports the sidecar's true counts (ADVICE r4: not None)
         info = generate_corpus(a, size_mb=0.2, n_extra_types=500, seed=7)
-        assert info["tokens"] is None
+        assert info.get("reused") and info["tokens"] > 0 and info["types"] > 0
+
+    def test_param_change_regenerates(self, tmp_path):
+        """ADVICE r4: a same-size corpus written with different generation
+        parameters must not be silently reused."""
+        from saturn_tpu.data.corpus_gen import generate_corpus
+
+        out = str(tmp_path / "a.txt")
+        generate_corpus(out, size_mb=0.2, n_extra_types=500, seed=7)
+        with open(out) as f:
+            body_seed7 = f.read()
+        info = generate_corpus(out, size_mb=0.2, n_extra_types=500, seed=8)
+        assert not info.get("reused")
+        with open(out) as f:
+            assert f.read() != body_seed7
+        # missing sidecar (pre-existing file of unknown provenance) -> rebuild
+        os.remove(out + ".meta.json")
+        info = generate_corpus(out, size_mb=0.2, n_extra_types=500, seed=8)
+        assert not info.get("reused") and info["tokens"] > 0
 
     def test_feeds_word_vocab_with_unk_pressure(self, tmp_path):
         """Generated text drives a capped vocab build end to end: more
