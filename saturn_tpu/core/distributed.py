@@ -209,7 +209,14 @@ def sync_task_state(task_list, src_ranks=None, updates=None) -> dict:
             payload = {
                 "state": {
                     t.name: {
-                        str(g): [s.per_batch_time, s.runtime]
+                        # The correction anchors ride along: without them a
+                        # rank that never executed this task would re-anchor
+                        # "trial" baselines from already-corrected values and
+                        # clobber self-measured siblings after a re-solve
+                        # moves the task to its block (round-5 review).
+                        str(g): [s.per_batch_time, s.runtime,
+                                 getattr(s, "_trial_per_batch", None),
+                                 bool(getattr(s, "_self_measured", False))]
                         for g, s in t.strategies.items()
                     }
                     for t in group
@@ -221,11 +228,15 @@ def sync_task_state(task_list, src_ranks=None, updates=None) -> dict:
             }
         payload = broadcast_json(payload, src=src)
         for t in group:
-            for g_str, (pbt, rt) in payload["state"].get(t.name, {}).items():
+            for g_str, vals in payload["state"].get(t.name, {}).items():
                 s = t.strategies.get(int(g_str))
                 if s is not None:
-                    s.per_batch_time = pbt
-                    s.runtime = rt
+                    s.per_batch_time = vals[0]
+                    s.runtime = vals[1]
+                    if len(vals) > 2:
+                        if vals[2] is not None:
+                            s._trial_per_batch = vals[2]
+                        s._self_measured = bool(vals[3])
         for name, pair in payload["updates"].items():
             merged_updates[name] = tuple(pair)
     return merged_updates

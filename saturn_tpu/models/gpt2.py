@@ -460,18 +460,58 @@ class GPT2(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def build_gpt2(name: str = "gpt2-small", **overrides) -> ModelSpec:
+def build_gpt2(
+    name: str = "gpt2-small", pretrained: Any = None, **overrides
+) -> ModelSpec:
     """Model factory suitable for ``Task(get_model=...)``.
 
     Returns a ModelSpec whose params tree is
     ``{'wte', 'blocks': {...leading layer axis...}, 'ln_f'}`` plus ``'wpe'``
     for non-rotary configs (rotary presets have no learned position table).
+
+    ``pretrained``: a local torch/npz state-dict path or an already-loaded
+    mapping in HF GPT-2/GPT-J naming — ``init_fn`` then returns the mapped
+    weights instead of a random init, which makes every technique a
+    *fine-tuning* executor (the reference's canonical workflow,
+    ``examples/wikitext103/models/GPTJ.py:502-526``). Shape-validated
+    against the preset up front; forwarded by ``Task.get_model`` kwargs like
+    any other override.
     """
     cfg = resolve_attention(config_for(name, **overrides))
     module = GPT2(cfg)
 
-    def init_fn(rng):
-        return module.init(rng, cfg.example_inputs())["params"]
+    if pretrained is None:
+        def init_fn(rng):
+            return module.init(rng, cfg.example_inputs())["params"]
+    else:
+        from saturn_tpu.models import ingest
+
+        if isinstance(pretrained, str):
+            # memoized: search builds one spec per candidate config and must
+            # not re-read a multi-GB checkpoint each time
+            mapped, unused = ingest.cached_params_from_path(pretrained, cfg)
+        else:
+            mapped, unused = ingest.params_from_state_dict(
+                dict(pretrained), cfg
+            )
+        if unused:
+            import logging
+
+            logging.getLogger("saturn_tpu").info(
+                "pretrained ingest: %d unused tensors (%s...)",
+                len(unused), ", ".join(unused[:4]))
+        ingest.validate_against(
+            mapped, jax.eval_shape(
+                lambda: module.init(jax.random.PRNGKey(0),
+                                    cfg.example_inputs())["params"]
+            )
+        )
+
+        def init_fn(rng):
+            del rng  # deterministic: weights come from the state dict
+            return jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, dtype=cfg.param_dtype), mapped
+            )
 
     def apply_fn(params, tokens):
         return module.apply({"params": params}, tokens)

@@ -1,0 +1,270 @@
+"""Pretrained-weight ingestion: HF/torch state dicts → saturn_tpu param trees.
+
+The reference's canonical workload is *fine-tuning* pretrained weights: its
+``get_model`` downloads HF GPT-J-6B, flattens the module tree into an
+``nn.Sequential``, and caches the result
+(``/root/reference/examples/wikitext103/models/GPTJ.py:502-526``). This module
+is the TPU-native analog: map a torch-format state dict (HF ``GPT2LMHeadModel``
+or ``GPTJForCausalLM`` naming) onto the scanned-stack flax tree that
+``models/gpt2.py`` trains — so a user can point a Task at downloaded weights
+and fine-tune under any technique the solver picks.
+
+Layout notes (the whole reason this mapper exists):
+
+- **HF GPT-2 uses Conv1D** — weights are stored ``(in, out)``, which IS the
+  flax ``Dense`` kernel layout: no transposes. Its ``c_attn`` is the same
+  fused q|k|v projection as our ``qkv``.
+- **HF GPT-J uses nn.Linear** — weights are ``(out, in)``: every matrix is
+  transposed, and the separate ``q/k/v_proj`` are fused into one ``qkv``
+  kernel. GPT-J's attention has no biases; ours do (zeros preserve the math).
+- **Per-layer tensors are stacked** along a leading layer axis, because the
+  block stack is one ``nn.scan`` (the property every executor shards).
+- **Vocab padding**: HF GPT-2's 50257 rows are zero-padded up to the
+  preset's lane-aligned ``vocab_size`` (50304). Padded rows are real vocab
+  entries the data pipeline never emits; zero embeddings contribute constant
+  logit 0, the standard padding treatment.
+- **Tied head**: our LM head is the tied ``wte`` (GPT-2's own convention).
+  GPT-J ships an untied ``lm_head``; by default its ``wte`` is loaded and the
+  ``lm_head`` tensors are reported in the returned ``unused`` list — pass
+  ``tie_from_lm_head=True`` to load the head matrix into ``wte`` instead
+  (better next-token fidelity, slightly worse embedding fidelity).
+- **Rotary convention**: HF GPT-J rotates interleaved (every-two) lanes;
+  ``models/gpt2.py`` rotates split halves. Equivalent up to a fixed lane
+  permutation learned away within a few fine-tuning steps; exact-logit parity
+  would need a per-head lane shuffle of q/k, documented here rather than
+  silently applied.
+
+No network access anywhere: callers hand a local path (torch ``.pt``/``.bin``
+or ``.npz``) or an already-loaded mapping. Tests exercise the full round trip
+against synthetically written torch-format state dicts
+(``tests/test_ingest.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "load_torch_state_dict",
+    "params_from_state_dict",
+    "gpt2_params_from_state_dict",
+    "gptj_params_from_state_dict",
+]
+
+
+_cache_key: Optional[tuple] = None
+_cache_val: Optional[tuple] = None
+
+
+def cached_params_from_path(path: str, cfg: Any, **kw):
+    """Load + map ``path`` once per (file, preset shape) — strategy search
+    builds one ModelSpec per candidate config (``spmd_base._build_uncached``),
+    and re-reading a multi-GB checkpoint per config would dominate the sweep.
+    Size-1 cache: a 6B mapped tree is ~24 GB of host RAM; never hold two."""
+    global _cache_key, _cache_val
+    import os
+
+    key = (
+        os.path.abspath(path), os.path.getmtime(path), cfg.n_layers,
+        cfg.d_model, cfg.vocab_size, cfg.seq_len, cfg.rotary,
+        tuple(sorted(kw.items())),
+    )
+    if _cache_key == key and _cache_val is not None:
+        return _cache_val
+    mapped, unused = params_from_state_dict(load_torch_state_dict(path),
+                                            cfg, **kw)
+    _cache_key, _cache_val = key, (mapped, unused)
+    return _cache_val
+
+
+def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Load a state dict from disk into plain numpy arrays.
+
+    Accepts torch-format files (``.pt``/``.bin``, loaded with
+    ``weights_only=True`` so untrusted pickles cannot execute code) and
+    ``.npz`` archives. Torch is an optional dependency of exactly this
+    loader — the rest of the framework never imports it.
+    """
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    import torch  # local import: only the ingestion path needs torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):  # a saved module instead of a state dict
+        sd = sd.state_dict()
+    return {k: v.detach().cpu().numpy() for k, v in sd.items()}
+
+
+def _strip_prefix(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Drop the HF ``transformer.`` wrapper prefix if present."""
+    if any(k.startswith("transformer.") for k in sd):
+        out = {}
+        for k, v in sd.items():
+            out[k.removeprefix("transformer.")] = v
+        return out
+    return dict(sd)
+
+
+def _stack(sd, fmt: str, n_layers: int, transpose: bool = False) -> np.ndarray:
+    tensors = []
+    for i in range(n_layers):
+        t = np.asarray(sd.pop(fmt.format(i)))
+        tensors.append(t.T if transpose else t)
+    return np.stack(tensors)
+
+
+def _pad_vocab(wte: np.ndarray, vocab_size: int, name: str) -> np.ndarray:
+    v, d = wte.shape
+    if v > vocab_size:
+        raise ValueError(
+            f"{name} has {v} rows but the model preset only has "
+            f"vocab_size={vocab_size}; pick a preset with vocab_size >= {v}"
+        )
+    if v < vocab_size:
+        wte = np.pad(wte, ((0, vocab_size - v), (0, 0)))
+    return wte
+
+
+def gpt2_params_from_state_dict(
+    sd: Dict[str, np.ndarray], cfg: Any
+) -> Tuple[Dict[str, Any], List[str]]:
+    """HF ``GPT2LMHeadModel`` state dict → saturn_tpu param tree.
+
+    Returns ``(params, unused_keys)``. Conv1D layout means zero transposes;
+    see module docstring for the vocab-pad and position-slice rules.
+    """
+    sd = _strip_prefix(sd)
+    L = cfg.n_layers
+    wpe = np.asarray(sd.pop("wpe.weight"))
+    if wpe.shape[0] < cfg.seq_len:
+        raise ValueError(
+            f"pretrained wpe covers {wpe.shape[0]} positions < seq_len "
+            f"{cfg.seq_len}"
+        )
+    params: Dict[str, Any] = {
+        "wte": _pad_vocab(np.asarray(sd.pop("wte.weight")), cfg.vocab_size,
+                          "wte.weight"),
+        "wpe": wpe[: cfg.seq_len],
+        "ln_f": {"scale": np.asarray(sd.pop("ln_f.weight")),
+                 "bias": np.asarray(sd.pop("ln_f.bias"))},
+        "blocks": {
+            "ln_1": {"scale": _stack(sd, "h.{}.ln_1.weight", L),
+                     "bias": _stack(sd, "h.{}.ln_1.bias", L)},
+            "ln_2": {"scale": _stack(sd, "h.{}.ln_2.weight", L),
+                     "bias": _stack(sd, "h.{}.ln_2.bias", L)},
+            "qkv": {"kernel": _stack(sd, "h.{}.attn.c_attn.weight", L),
+                    "bias": _stack(sd, "h.{}.attn.c_attn.bias", L)},
+            "attn_out": {"kernel": _stack(sd, "h.{}.attn.c_proj.weight", L),
+                         "bias": _stack(sd, "h.{}.attn.c_proj.bias", L)},
+            "mlp_in": {"kernel": _stack(sd, "h.{}.mlp.c_fc.weight", L),
+                       "bias": _stack(sd, "h.{}.mlp.c_fc.bias", L)},
+            "mlp_out": {"kernel": _stack(sd, "h.{}.mlp.c_proj.weight", L),
+                        "bias": _stack(sd, "h.{}.mlp.c_proj.bias", L)},
+        },
+    }
+    return params, sorted(sd)
+
+
+def gptj_params_from_state_dict(
+    sd: Dict[str, np.ndarray], cfg: Any, tie_from_lm_head: bool = False
+) -> Tuple[Dict[str, Any], List[str]]:
+    """HF ``GPTJForCausalLM`` state dict → saturn_tpu param tree.
+
+    Linear layout: every matrix transposes; q/k/v fuse into ``qkv``; the
+    bias-free attention projections get zero biases. ``tie_from_lm_head``
+    loads the untied head matrix into the tied ``wte`` slot (see module
+    docstring).
+    """
+    sd = _strip_prefix(sd)
+    L, D = cfg.n_layers, cfg.d_model
+    qkv_k = np.concatenate(
+        [
+            _stack(sd, "h.{}.attn.q_proj.weight", L, transpose=True),
+            _stack(sd, "h.{}.attn.k_proj.weight", L, transpose=True),
+            _stack(sd, "h.{}.attn.v_proj.weight", L, transpose=True),
+        ],
+        axis=2,
+    )
+    wte_key = "lm_head.weight" if tie_from_lm_head else "wte.weight"
+    wte = np.asarray(sd.pop(wte_key))
+    sd.pop("wte.weight" if tie_from_lm_head else "lm_head.weight", None)
+    sd.pop("lm_head.bias", None)  # tied head has no bias slot
+    # HF GPT-J registers rotary caches as buffers in some versions
+    for k in [k for k in sd if k.endswith(("attn.bias", "attn.masked_bias",
+                                           "embed_positions.weight"))]:
+        sd.pop(k)
+    params: Dict[str, Any] = {
+        "wte": _pad_vocab(wte, cfg.vocab_size, wte_key),
+        "ln_f": {"scale": np.asarray(sd.pop("ln_f.weight")),
+                 "bias": np.asarray(sd.pop("ln_f.bias"))},
+        "blocks": {
+            "ln_1": {"scale": _stack(sd, "h.{}.ln_1.weight", L),
+                     "bias": _stack(sd, "h.{}.ln_1.bias", L)},
+            "qkv": {"kernel": qkv_k,
+                    "bias": np.zeros((L, 3 * D), dtype=qkv_k.dtype)},
+            "attn_out": {
+                "kernel": _stack(sd, "h.{}.attn.out_proj.weight", L,
+                                 transpose=True),
+                "bias": np.zeros((L, D), dtype=qkv_k.dtype),
+            },
+            "mlp_in": {"kernel": _stack(sd, "h.{}.mlp.fc_in.weight", L,
+                                        transpose=True),
+                       "bias": _stack(sd, "h.{}.mlp.fc_in.bias", L)},
+            "mlp_out": {"kernel": _stack(sd, "h.{}.mlp.fc_out.weight", L,
+                                         transpose=True),
+                        "bias": _stack(sd, "h.{}.mlp.fc_out.bias", L)},
+        },
+    }
+    return params, sorted(sd)
+
+
+def params_from_state_dict(
+    sd: Dict[str, np.ndarray], cfg: Any, **kw
+) -> Tuple[Dict[str, Any], List[str]]:
+    """Dispatch on the state dict's key signature (GPT-2 vs GPT-J naming)."""
+    keys = set(_strip_prefix(sd))
+    if any(".attn.c_attn." in k for k in keys):
+        if kw:
+            raise TypeError(f"GPT-2 mapping takes no options, got {kw}")
+        return gpt2_params_from_state_dict(sd, cfg)
+    if any(".attn.q_proj." in k for k in keys):
+        return gptj_params_from_state_dict(sd, cfg, **kw)
+    raise ValueError(
+        "unrecognized state-dict family: expected HF GPT-2 (attn.c_attn) or "
+        "GPT-J (attn.q_proj) key naming; got keys like "
+        + ", ".join(sorted(keys)[:5])
+    )
+
+
+def validate_against(params: Dict[str, Any], template: Any) -> None:
+    """Shape-check a mapped tree against the model's own init structure,
+    naming every mismatched path (a wrong preset fails loudly here, not as
+    an XLA shape error three layers deep)."""
+    import jax
+
+    def flat(tree):
+        return {
+            jax.tree_util.keystr(k): v
+            for k, v in jax.tree_util.tree_flatten_with_path(tree)[0]
+        }
+
+    flat_p, flat_t = flat(params), flat(template)
+    problems = []
+    for k in sorted(set(flat_p) | set(flat_t)):
+        if k not in flat_p:
+            problems.append(f"missing {k}")
+        elif k not in flat_t:
+            problems.append(f"unexpected {k}")
+        elif tuple(flat_p[k].shape) != tuple(flat_t[k].shape):
+            problems.append(
+                f"{k}: got {tuple(flat_p[k].shape)}, "
+                f"model wants {tuple(flat_t[k].shape)}"
+            )
+    if problems:
+        raise ValueError(
+            "pretrained state dict does not match this model preset:\n  "
+            + "\n  ".join(problems)
+        )

@@ -545,6 +545,12 @@ class SPMDTechnique(BaseTechnique):
 
         start = task.current_batch
         loss = None
+        # Whether this bundle had already compiled before the interval: if
+        # so, even an n==1 interval yields a clean compile-free sample (a
+        # task forecast at one batch per interval must not be starved of
+        # feedback forever — its wrong trial profile is exactly what the
+        # feedback exists to fix).
+        was_warm = bundle._compiled is not None
         t_all0 = _timeit.default_timer()
         t_steady = t_all0
         for i in range(n):
@@ -576,12 +582,16 @@ class SPMDTechnique(BaseTechnique):
                 # feed the profiled-vs-realized loop from the steady-state
                 # window only (batches 2..n); a compile-dominated first
                 # interval would otherwise inflate the EWMA many-fold and
-                # propagate to every sibling strategy. n == 1 gives no
-                # compile-free sample, so no feedback is noted.
+                # propagate to every sibling strategy.
                 per_batch = (t_end - t_steady) / (n - 1)
                 task.note_realized_per_batch(per_batch)
             else:
                 per_batch = elapsed_all
+                if was_warm:
+                    # single-batch interval on an already-compiled bundle:
+                    # still a clean sample — without it a task scheduled one
+                    # batch per interval never gets corrected.
+                    task.note_realized_per_batch(per_batch)
             from saturn_tpu.utils import metrics as _metrics
 
             _metrics.event(
