@@ -1,0 +1,101 @@
+"""Cold vs warm trial-sweep benchmark: what the persistent profile cache buys.
+
+Runs ``saturn_tpu.search`` twice over the standard two-task CPU fixture
+(tiny GPT-2, 8 virtual devices — the ``tests/test_e2e.py`` shape) against a
+fresh cache directory: the first sweep compiles real trials, the second must
+resolve every grid point from the profile cache without a single
+``technique.search`` execution. Prints ONE JSON line like ``bench.py``:
+
+    {"metric": "sweep_cache_warm_speedup", "value": <cold/warm>, "unit": "x",
+     "cold_s": ..., "warm_s": ...}
+
+Hardware-free by construction (``JAX_PLATFORMS=cpu`` is forced before jax
+imports), so the number is about orchestration overhead, not TPU compiles —
+on real hardware the gap widens by the ~1 min/trial compile cost this
+eliminates. Run: ``python benchmarks/sweep_cache.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import timeit
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import saturn_tpu
+from saturn_tpu import HParams, Task, library
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.data.lm_dataset import make_lm_dataset
+from saturn_tpu.models.gpt2 import build_gpt2
+from saturn_tpu.models.loss import pretraining_loss
+
+
+def make_task(save_dir: str, name: str, lr: float) -> Task:
+    return Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256, n_tokens=64 * 8 * 8
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=lr, batch_count=8),
+        chip_range=[4],
+        name=name,
+        save_dir=save_dir,
+    )
+
+
+def run_sweep(cache_dir: str, work_dir: str, tag: str) -> float:
+    # Fresh task objects per sweep: a warm hit must come from the persistent
+    # cache's content fingerprints, not from state left on the task.
+    tasks = [
+        make_task(work_dir, f"{tag}-lr3", 1e-3),
+        make_task(work_dir, f"{tag}-lr4", 1e-4),
+    ]
+    topo = SliceTopology(jax.devices())
+    t0 = timeit.default_timer()
+    saturn_tpu.search(
+        tasks, technique_names=["dp"], topology=topo, profile_cache=cache_dir
+    )
+    dt = timeit.default_timer() - t0
+    for t in tasks:
+        assert t.feasible_strategies(), f"no feasible strategy for {t.name}"
+    return dt
+
+
+def main() -> None:
+    library.register_default_library()
+    root = tempfile.mkdtemp(prefix="saturn_sweep_cache_")
+    cache_dir = os.path.join(root, "profiles")
+    try:
+        cold = run_sweep(cache_dir, os.path.join(root, "w1"), "cold")
+        warm = run_sweep(cache_dir, os.path.join(root, "w2"), "warm")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(
+        json.dumps(
+            {
+                "metric": "sweep_cache_warm_speedup",
+                "value": round(cold / warm, 2) if warm > 0 else None,
+                "unit": "x",
+                "cold_s": round(cold, 3),
+                "warm_s": round(warm, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,26 @@ class SliceTopology:
                 n - self.capacity, n, self.capacity, self.capacity, n,
             )
 
+    def signature(self) -> str:
+        """Stable content signature of the accelerator pool, for the
+        persistent profile cache (``utils/profile_cache.py``): per-batch
+        timings measured on one topology must never be served on another —
+        platform, device generation, capacity, slice boundaries and host
+        count all change collective costs."""
+        d0 = self.devices[0] if self.devices else None
+        procs = len({getattr(d, "process_index", 0) for d in self.devices})
+        return "|".join(
+            str(p)
+            for p in (
+                len(self.devices),
+                self.capacity,
+                self.slice_size,
+                procs,
+                getattr(d0, "platform", "cpu"),
+                getattr(d0, "device_kind", "unknown"),
+            )
+        )
+
     def crosses_dcn(self, block: Block) -> bool:
         """Does this block span more than one ICI slice?"""
         return (block.offset // self.slice_size) != (

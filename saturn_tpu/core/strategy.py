@@ -53,6 +53,15 @@ class Strategy:
     params: Optional[Dict[str, Any]]   # autotuned knobs; None = infeasible
     runtime: float                     # est. remaining runtime, seconds
     per_batch_time: float = field(default=0.0)  # seconds per batch (profiled)
+    # Cost-model estimate, not a measured trial: the trial runner profiles
+    # only anchor sizes and fills the rest from an Amdahl-style fit
+    # (``trial_runner/evaluator.py``). Cleared the first time a realized
+    # interval measurement lands on this strategy (``Task.apply_realized_feedback``).
+    interpolated: bool = field(default=False)
+    # Persistent profile-cache fingerprint for this (task, technique, size)
+    # grid point (``utils/profile_cache.py``) — lets the orchestrator write
+    # realized measurements back to the cache.
+    cache_key: Optional[str] = field(default=None)
 
     def __post_init__(self) -> None:
         if self.apportionment < 1:

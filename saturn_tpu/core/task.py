@@ -110,6 +110,9 @@ class Task:
         # (strategy, realized per-batch seconds) noted by the executor, folded
         # in by the orchestrator between intervals (see note_realized_per_batch)
         self._pending_realized: Optional[tuple] = None
+        # The strategy the most recent apply_realized_feedback measured —
+        # the orchestrator persists its per-batch time to the profile cache.
+        self.last_feedback_strategy: Optional[Strategy] = None
 
     def release_live_state(self) -> None:
         """Drop the cached device train state (frees HBM). Safe on a task
@@ -238,6 +241,11 @@ class Task:
             if old > 0.0 else realized
         )
         strat._self_measured = True
+        # A realized measurement upgrades a cost-model estimate to a measured
+        # entry — the trial runner only profiled anchor sizes and
+        # interpolated this one (``trial_runner/evaluator.py``).
+        strat.interpolated = False
+        self.last_feedback_strategy = strat
         strat.runtime = strat.per_batch_time * max(self.total_batches, 0)
         trial_base = getattr(strat, "_trial_per_batch", 0.0) or 0.0
         if trial_base > 0.0:

@@ -38,6 +38,19 @@ class BaseTechnique(abc.ABC):
     #: declared its enum but nothing ever read it (``Strategy.py:25-34``).
     technique = None  # type: ignore[assignment]  # Optional[Techniques]
 
+    #: Declares that this technique's per-chip memory footprint is
+    #: non-increasing in sub-mesh size (smaller block => per-chip memory the
+    #: same or strictly higher). True for every sharding-based technique:
+    #: replicated state is constant per chip while sharded state shrinks as
+    #: the block grows. The trial runner uses it to propagate XLA memory
+    #: infeasibility monotonically — a memory rejection at size ``g`` skips
+    #: the trials at every smaller size instead of compiling them to fail.
+    #: Techniques additionally expose the rejection reason via
+    #: ``search_report`` (see ``SPMDTechnique``); without a report claiming
+    #: the rejection was memory-bound, nothing is propagated (a batch
+    #: divisibility failure at a LARGE size says nothing about small ones).
+    memory_monotone: bool = False
+
     @abc.abstractmethod
     def execute(
         self,

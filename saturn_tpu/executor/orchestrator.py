@@ -112,6 +112,42 @@ def orchestrate(
             )
 
 
+def _persist_realized(task) -> None:
+    """Write the task's freshly measured per-batch time back to the
+    persistent profile cache (``utils/profile_cache.py``).
+
+    This is what upgrades interpolated trial-sweep entries to measured ones
+    *across processes*: the in-process upgrade happens in
+    ``Task.apply_realized_feedback`` (flag cleared, EWMA folded in), and this
+    write makes the next driver's ``search()`` start from realized numbers
+    instead of solo-trial or cost-model estimates. Only the self-measured
+    strategy is persisted — sibling ratio corrections are derived, not
+    evidence."""
+    strat = getattr(task, "last_feedback_strategy", None)
+    key = getattr(strat, "cache_key", None) if strat is not None else None
+    if not key or not strat.feasible:
+        return
+    from saturn_tpu.utils import profile_cache as pcache
+
+    cache = pcache.default_cache()
+    if cache is None:
+        return
+    try:
+        wrote = cache.note_realized(
+            key, strat.per_batch_time, strat.params,
+            technique=getattr(strat.executor, "name", "unknown"),
+            size=strat.apportionment,
+        )
+        if wrote:
+            metrics.event(
+                "profile_cache", op="realized_writeback", task=task.name,
+                size=strat.apportionment, per_batch_s=strat.per_batch_time,
+            )
+    except Exception:
+        logger.debug("profile cache write-back failed for %s", task.name,
+                     exc_info=True)
+
+
 def _orchestrate_loop(
     task_list, topo, interval, threshold, tlimit, failure_policy,
     max_task_retries, metrics_path, trace_dir,
@@ -230,6 +266,7 @@ def _orchestrate_loop(
                     upd = apply_fb() if apply_fb is not None else None
                     if upd is not None:
                         local_updates[t.name] = upd
+                        _persist_realized(t)
                 all_updates = local_updates
                 if multihost and run_tasks:
                     # All ranks must forecast from identical numbers. Each

@@ -67,6 +67,12 @@ class SPMDTechnique(BaseTechnique):
 
     name = "spmd"
 
+    # Per-chip memory never grows with block size under sharding: replicated
+    # state is constant per chip, sharded state (params, activations, layer
+    # spans, expert tables) shrinks. Lets the trial runner skip all smaller
+    # sizes once XLA memory analysis rejects one (``core/technique.py``).
+    memory_monotone = True
+
     # Per-instance ceiling on cached compiled programs. A 16-task ×
     # multi-config × multi-block sweep would otherwise hold every executable
     # for the life of the technique (VERDICT r2 weak #7); LRU keeps the
@@ -100,6 +106,17 @@ class SPMDTechnique(BaseTechnique):
 
         self._bundles: "OrderedDict[Any, _Bundle]" = OrderedDict()
         self._bundles_lock = threading.Lock()
+        # Why each (task, size) search came back infeasible — consumed (and
+        # popped) by the trial runner's monotone pruning. Keyed per grid
+        # point because one instance serves concurrent trial threads.
+        self._search_reports: Dict[Any, Dict[str, Any]] = {}
+        self._reports_lock = threading.Lock()
+
+    def search_report(self, task_name: str, size: int) -> Optional[Dict[str, Any]]:
+        """Pop the infeasibility report for the most recent ``search`` of
+        (task, size); None when the search was feasible or never ran."""
+        with self._reports_lock:
+            return self._search_reports.pop((task_name, size), None)
 
     def release_task(self, task_name: str) -> None:
         """Drop every cached compiled program for ``task_name`` — called when
@@ -231,7 +248,10 @@ class SPMDTechnique(BaseTechnique):
             if single:
                 fused_loss = fused
             else:
-                from jax import shard_map
+                try:
+                    from jax import shard_map
+                except ImportError:  # jax < 0.5 keeps it in experimental
+                    from jax.experimental.shard_map import shard_map
 
                 axes = tuple(mesh.axis_names)
                 bspec = batch_partition if batch_partition is not None else P(
@@ -402,6 +422,14 @@ class SPMDTechnique(BaseTechnique):
     def _build_uncached(
         self, task: Any, devices: Sequence[Any], config: Dict[str, Any]
     ) -> _Bundle:
+        # Persistent XLA compilation cache (opt-in via
+        # SATURN_TPU_COMPILE_CACHE_DIR): every compile — trial-time AND the
+        # execution engine's bundle builds — lands in one on-disk cache, so a
+        # program compiled by a sweep is reused by later intervals and later
+        # processes. Idempotent no-op when unconfigured.
+        from saturn_tpu.utils import profile_cache as _pcache
+
+        _pcache.maybe_enable_persistent_compile_cache()
         spec = task.get_model(**self._model_overrides(config))
         axis_names, axis_sizes = self.mesh_spec(len(devices), task, config)
         mesh = make_submesh(devices, axis_names, axis_sizes)
@@ -473,16 +501,32 @@ class SPMDTechnique(BaseTechnique):
         self, task: Any, devices: Sequence[Any], tid: int
     ) -> Tuple[Optional[Dict[str, Any]], Optional[float]]:
         best: Tuple[Optional[Dict[str, Any]], Optional[float]] = (None, None)
+        n_configs = n_memory = n_error = 0
         for config in self.candidate_configs(task, len(devices)):
+            n_configs += 1
             try:
                 t = self._try_config(task, devices, config)
             except Exception as e:  # infeasible configs must not kill the sweep
                 log.info("%s trial %s failed: %r", self.name, config, e)
+                n_error += 1
                 continue
-            if t is None:
+            if t is None:  # _try_config returns None only on the memory check
+                n_memory += 1
                 continue
             if best[1] is None or t < best[1]:
                 best = (dict(config), t)
+        if best[1] is None:
+            # Memory is the binding constraint only when EVERY candidate was
+            # rejected by XLA memory analysis — a mesh/divisibility error in
+            # any config means smaller sizes might still work, so monotone
+            # pruning must not engage.
+            with self._reports_lock:
+                self._search_reports[(task.name, len(devices))] = {
+                    "memory_infeasible": n_configs > 0 and n_memory == n_configs,
+                    "configs": n_configs,
+                    "memory_rejected": n_memory,
+                    "errors": n_error,
+                }
         return best
 
     def _try_config(

@@ -20,6 +20,27 @@ TPU-native differences:
 - Infeasible configs are rejected by XLA memory analysis inside each
   technique's ``search`` (see ``SPMDTechnique._fits_memory``) rather than
   try/except CUDA OOM probing.
+
+Profiling cost is the most expensive phase of the whole pipeline (compile
+dominates a trial; ~1 min upper bound each), so three layers keep the sweep
+cheap (see ``docs/architecture.md`` "Profiling cost & caching"):
+
+1. **Persistent profile cache** (``utils/profile_cache.py``): every grid
+   point is looked up by content fingerprint before anything compiles and
+   every trial outcome is written back, so a repeated ``search()`` over an
+   unchanged task list performs zero trial executions.
+2. **Cost-model pruning**: on grids of >= ``PRUNE_MIN_GRID`` sizes per
+   (task, technique), only anchor sizes (min, max, one midpoint) are
+   profiled; the rest are filled from an Amdahl-style fit
+   ``t(g) = a + b/g`` as *interpolated* strategies (flagged on
+   ``Strategy``). The solver still sees a complete per-size table, and the
+   orchestrator's realized-feedback loop upgrades interpolated entries to
+   measured ones as tasks actually run.
+3. **Monotone infeasibility propagation**: sizes are profiled largest-first,
+   and once XLA memory analysis rejects a technique at size ``g``
+   (``technique.memory_monotone`` + the search report saying memory was the
+   binding constraint), every smaller size — whose per-chip memory is the
+   same or strictly higher — is skipped instead of compiled-to-fail.
 """
 
 from __future__ import annotations
@@ -29,16 +50,21 @@ import queue
 import threading
 import timeit
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from saturn_tpu import library as lib
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.core.strategy import Strategy
 from saturn_tpu.utils import metrics, trace
+from saturn_tpu.utils import profile_cache as pcache
 
 logger = logging.getLogger("saturn_tpu")
 
 DUMMY_RUNTIME = 1e6  # reference's unsearched-size sentinel (``:99``)
+
+#: Anchor-size pruning engages only when a (task, technique) pair has at
+#: least this many valid sizes — below it the anchors ARE the whole grid.
+PRUNE_MIN_GRID = 4
 
 
 def search(
@@ -49,6 +75,9 @@ def search(
     metrics_path: Optional[str] = None,
     trace_dir: Optional[str] = None,
     parallel_trials: Optional[int] = None,
+    profile_cache: Any = None,
+    prune: bool = True,
+    compile_cache_dir: Optional[str] = None,
 ) -> None:
     """Fill ``task.strategies`` for every task in place.
 
@@ -59,11 +88,21 @@ def search(
     jax.profiler trace. ``parallel_trials`` caps how many same-size trials
     run concurrently on disjoint blocks (default: 4 on accelerators, 1 on
     the CPU test platform where concurrency would skew timings).
+
+    ``profile_cache``: ``None`` uses the env-configured persistent cache
+    (default on; ``SATURN_TPU_PROFILE_CACHE=0`` disables), ``False`` turns
+    caching off for this sweep, a path string uses that directory.
+    ``prune`` toggles anchor-size cost-model pruning. ``compile_cache_dir``
+    additionally roots JAX's persistent compilation cache there for this
+    process (same effect as ``SATURN_TPU_COMPILE_CACHE_DIR``).
     """
     if log:
         logging.basicConfig(level=logging.INFO)
+    if compile_cache_dir:
+        pcache.maybe_enable_persistent_compile_cache(compile_cache_dir)
+    cache = pcache.resolve(profile_cache)
     with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
-        _search_inner(tasks, technique_names, topology, parallel_trials)
+        _search_inner(tasks, technique_names, topology, parallel_trials, cache, prune)
 
 
 def _default_parallelism(topo: SliceTopology) -> int:
@@ -71,43 +110,236 @@ def _default_parallelism(topo: SliceTopology) -> int:
     return 4 if platform != "cpu" else 1
 
 
-def _search_inner(tasks, technique_names, topology, parallel_trials=None) -> None:
+def _anchor_sizes(sizes: Sequence[int]) -> set:
+    """min, max and one midpoint of the valid sizes: the three points an
+    Amdahl-style fit needs, and the cheapest/most constrained ends of the
+    grid (GSPMD's observation that per-size runtimes scale smoothly)."""
+    ss = sorted(sizes)
+    return {ss[0], ss[-1], ss[len(ss) // 2]}
+
+
+def _fit_scaling_model(points: Sequence[Tuple[int, float]]):
+    """Least-squares Amdahl fit ``t(g) = a + b/g`` over measured
+    (size, per-batch seconds) points; degenerate fits clamp to the
+    pure-serial / pure-parallel edge instead of going negative."""
+    import numpy as np
+
+    g = np.asarray([p[0] for p in points], dtype=float)
+    t = np.asarray([p[1] for p in points], dtype=float)
+    A = np.stack([np.ones_like(g), 1.0 / g], axis=1)
+    try:
+        (a, b), *_ = np.linalg.lstsq(A, t, rcond=None)
+    except np.linalg.LinAlgError:
+        a, b = float(t.mean()), 0.0
+    if a < 0.0 or b < 0.0:
+        if b < 0.0:  # "runtime grows with chips" noise -> flat (serial) model
+            a, b = float(t.mean()), 0.0
+        else:
+            a, b = 0.0, float((t * g).mean())
+    return lambda size: a + b / float(size)
+
+
+class _Lane:
+    """Per-(task, technique) sweep state: which sizes are resolved and how."""
+
+    __slots__ = (
+        "task", "name", "tech", "sizes", "keys", "done", "to_run", "to_fill",
+        "infeasible_floor",
+    )
+
+    def __init__(self, task, name, tech, sizes):
+        self.task = task
+        self.name = name
+        self.tech = tech
+        self.sizes = sorted(sizes)
+        self.keys: Dict[int, Optional[str]] = {}
+        # size -> (feasible, params, per_batch_time, source)
+        self.done: Dict[int, tuple] = {}
+        self.to_run: List[int] = []
+        self.to_fill: List[int] = []
+        # Largest size rejected by XLA memory analysis (memory-monotone
+        # techniques only): everything smaller needs at least as much
+        # per-chip memory and is pruned without compiling.
+        self.infeasible_floor: Optional[int] = None
+
+    def pruned(self, g: int) -> bool:
+        return self.infeasible_floor is not None and g < self.infeasible_floor
+
+
+class _EtaTracker:
+    """Running-average trial-time ETA, replacing the fixed ~1 min/trial log.
+
+    Cache hits and pruned grid points cost ~0 and are excluded from the
+    average; the ETA covers only the trials still waiting to compile."""
+
+    def __init__(self, planned: int, hits: int, deferred: int):
+        self.planned = planned
+        self.hits = hits
+        self.deferred = deferred
+        self.completed = 0
+        self.pruned = 0
+        self.spent = 0.0
+        self._lock = threading.Lock()
+
+    def start_message(self) -> str:
+        return (
+            f"trial runner: {self.planned} trials to run "
+            f"({self.hits} profile-cache hits, {self.deferred} grid points "
+            f"deferred to the cost model; cold upper bound ~{self.planned:.0f} min)"
+        )
+
+    def trial_done(self, dt: float) -> str:
+        with self._lock:
+            self.completed += 1
+            self.spent += dt
+            remaining = max(self.planned - self.pruned - self.completed, 0)
+            avg = self.spent / self.completed
+            return (
+                f"trial runner: {self.completed}/{self.planned - self.pruned} "
+                f"trials done, avg {avg:.1f}s/trial, ETA {remaining * avg:.0f}s"
+            )
+
+    def trial_pruned(self) -> None:
+        with self._lock:
+            self.pruned += 1
+
+
+def _search_inner(
+    tasks, technique_names, topology, parallel_trials=None, cache=None, prune=True
+) -> None:
     topo = topology if topology is not None else SliceTopology()
     if technique_names is None and not lib.registered_names():
         lib.register_default_library()
     classes = lib.retrieve(technique_names)
     techniques = [(cls.name if hasattr(cls, "name") else cls.__name__, cls()) for cls in classes]
 
-    # Trial grid + ETA estimate (reference ``:86-91``).
-    grid = []
+    update_lock = threading.Lock()
+
+    # One lane per (task, technique): the unit pruning and interpolation
+    # reason about (reference grid build, ``:86-91``).
+    lanes: List[_Lane] = []
+    # NB ``is not None``: ProfileCache defines __len__, so a still-empty
+    # cache is falsy — a bare truthiness test would fingerprint the first
+    # run with a blank topology signature and never hit again.
+    topo_sig = pcache.topology_signature(topo) if cache is not None else ""
     for task in tasks:
         sizes = topo.valid_sizes()
         if task.chip_range is not None:
             sizes = [s for s in sizes if s in task.chip_range]
-        for g in sizes:
-            for name, tech in techniques:
-                grid.append((task, g, name, tech))
-    # ETA estimate: compile dominates a trial; ~1 min upper bound per trial
-    # matches the reference's ~1.2 min rule of thumb (``:86-91``).
-    logger.info(
-        "trial runner: %d trials queued (≤ ~%.0f min)", len(grid), len(grid) * 1.0
+        task_sig = None
+        if cache is not None:
+            try:
+                task_sig = pcache.task_signature(task)
+            except Exception:
+                logger.info("task %s not fingerprintable — caching off for it",
+                            task.name, exc_info=True)
+        for name, tech in techniques:
+            lane = _Lane(task, name, tech, sizes)
+            if task_sig is not None:
+                for g in lane.sizes:
+                    lane.keys[g] = pcache.fingerprint(task_sig, name, g, topo_sig)
+            lanes.append(lane)
+
+    def install(lane: _Lane, g: int, params, per_batch: float, source: str) -> None:
+        """Fastest feasible technique per size wins (``:101-115``) —
+        measured, cached and interpolated entries all compete."""
+        total = per_batch * lane.task.total_batches  # reference ``:26``
+        with update_lock:
+            cur = lane.task.strategies.get(g)
+            if cur is None or not cur.feasible or total < cur.runtime:
+                lane.task.strategies[g] = Strategy(
+                    executor=lane.tech,
+                    apportionment=g,
+                    params=params,
+                    runtime=total,
+                    per_batch_time=per_batch,
+                    interpolated=(source == "interpolated"),
+                    cache_key=lane.keys.get(g),
+                )
+
+    def note_memory_floor(lane: _Lane, g: int) -> None:
+        if getattr(lane.tech, "memory_monotone", False):
+            with update_lock:
+                if lane.infeasible_floor is None or g > lane.infeasible_floor:
+                    lane.infeasible_floor = g
+
+    # ------------------------------------------------------------ cache pass
+    # Consult the persistent profile cache for EVERY grid point before any
+    # trial runs: hits — feasible or infeasible — cost a file read.
+    n_hits = 0
+    for lane in lanes:
+        for g in lane.sizes:
+            entry = cache.get(lane.keys.get(g)) if cache is not None else None
+            if entry is None:
+                continue
+            n_hits += 1
+            feasible = entry["feasible"]
+            metrics.event(
+                "profile_cache", hit=True, task=lane.task.name, size=g,
+                technique=lane.name, feasible=feasible,
+                source=entry.get("source", "trial"),
+            )
+            if feasible:
+                lane.done[g] = (True, entry["params"], entry["per_batch_time"],
+                                entry.get("source", "trial"))
+                install(lane, g, entry["params"], entry["per_batch_time"], "cache")
+            else:
+                lane.done[g] = (False, None, None, entry.get("source", "trial"))
+                if entry.get("memory_infeasible"):
+                    note_memory_floor(lane, g)
+
+    # -------------------------------------------------------- pruning split
+    # Uncached grid points either run for real (anchors, or everything when
+    # pruning is off / the grid is small) or wait for the cost-model fill.
+    for lane in lanes:
+        missing = [g for g in lane.sizes if g not in lane.done]
+        if prune and len(lane.sizes) >= PRUNE_MIN_GRID:
+            anchors = _anchor_sizes(lane.sizes)
+            lane.to_run = [g for g in missing if g in anchors]
+            lane.to_fill = [g for g in missing if g not in anchors]
+        else:
+            lane.to_run = missing
+
+    eta = _EtaTracker(
+        planned=sum(len(l.to_run) for l in lanes),
+        hits=n_hits,
+        deferred=sum(len(l.to_fill) for l in lanes),
     )
+    logger.info("%s", eta.start_message())
 
     workers = parallel_trials if parallel_trials is not None else _default_parallelism(topo)
-    update_lock = threading.Lock()
 
-    def run_trial(tid, task, g, name, tech, block):
+    def run_trial(tid, lane: _Lane, g: int, block):
         devices = block.devices_of(topo.devices)
+        task, name, tech = lane.task, lane.name, lane.tech
+        if cache is not None and lane.keys.get(g):
+            metrics.event("profile_cache", hit=False, task=task.name, size=g,
+                          technique=name)
         t0 = timeit.default_timer()
         try:
             params, per_batch_time = tech.search(task, devices, tid)
         except Exception as e:  # a broken trial must not kill the sweep (``:27-28``)
             logger.info("trial (%s, g=%d, %s) raised: %r", task.name, g, name, e)
             params, per_batch_time = None, None
+        dt = timeit.default_timer() - t0
         if params is None or per_batch_time is None:
-            logger.info("trial (%s, g=%d, %s): infeasible", task.name, g, name)
+            report = None
+            reporter = getattr(tech, "search_report", None)
+            if callable(reporter):
+                report = reporter(task.name, g)
+            memory_bound = bool(report and report.get("memory_infeasible"))
+            logger.info("trial (%s, g=%d, %s): infeasible%s", task.name, g, name,
+                        " (memory)" if memory_bound else "")
             metrics.event("trial", task=task.name, size=g, technique=name,
-                          feasible=False)
+                          feasible=False, memory_infeasible=memory_bound)
+            with update_lock:
+                lane.done[g] = (False, None, None, "trial")
+            if memory_bound:
+                note_memory_floor(lane, g)
+            if cache is not None:
+                cache.put(lane.keys.get(g), technique=name, size=g, feasible=False,
+                          memory_infeasible=memory_bound)
+            logger.info("%s", eta.trial_done(dt))
             return
         total = per_batch_time * task.total_batches  # reference ``:26``
         metrics.event("trial", task=task.name, size=g, technique=name,
@@ -115,57 +347,124 @@ def _search_inner(tasks, technique_names, topology, parallel_trials=None) -> Non
                       est_total_s=total, params=params)
         logger.info(
             "trial (%s, g=%d, %s): %.4fs/batch, est total %.1fs (trial took %.1fs)",
-            task.name, g, name, per_batch_time, total, timeit.default_timer() - t0,
+            task.name, g, name, per_batch_time, total, dt,
         )
         with update_lock:
-            cur = task.strategies.get(g)
-            # fastest feasible technique per size wins (``:101-115``)
-            if cur is None or not cur.feasible or total < cur.runtime:
-                task.strategies[g] = Strategy(
-                    executor=tech,
-                    apportionment=g,
-                    params=params,
-                    runtime=total,
-                    per_batch_time=per_batch_time,
-                )
+            lane.done[g] = (True, params, per_batch_time, "trial")
+        install(lane, g, params, per_batch_time, "trial")
+        if cache is not None:
+            cache.put(lane.keys.get(g), technique=name, size=g, feasible=True,
+                      params=params, per_batch_time=per_batch_time)
+        logger.info("%s", eta.trial_done(dt))
 
-    if workers <= 1:
-        for tid, (task, g, name, tech) in enumerate(grid):
-            run_trial(tid, task, g, name, tech, topo.blocks(g)[0])
-    else:
+    def prune_point(lane: _Lane, g: int, reason: str, planned: bool) -> None:
+        if planned:  # only planned trials count against the ETA denominator
+            eta.trial_pruned()
+        with update_lock:
+            lane.done[g] = (False, None, None, "pruned")
+        metrics.event("trial_pruned", task=lane.task.name, size=g,
+                      technique=lane.name, reason=reason)
+        logger.info("trial (%s, g=%d, %s): pruned (%s)",
+                    lane.task.name, g, lane.name, reason)
+
+    # ------------------------------------------------------------ trial pass
+    # Size classes run LARGEST-FIRST with a barrier between classes, so a
+    # memory rejection at size g prunes every smaller (>= per-chip memory)
+    # size before it compiles. Within a class the existing disjoint-block
+    # fan-out applies unchanged.
+    tid_counter = [0]
+
+    def next_tid() -> int:
+        with update_lock:
+            tid_counter[0] += 1
+            return tid_counter[0]
+
+    run_sizes = sorted({g for lane in lanes for g in lane.to_run}, reverse=True)
+    for g in run_sizes:
+        items: List[_Lane] = []
+        for lane in lanes:
+            if g not in lane.to_run:
+                continue
+            if lane.pruned(g):
+                prune_point(lane, g, "memory_monotone", planned=True)
+            else:
+                items.append(lane)
+        if not items:
+            continue
+        blocks = topo.blocks(g)
+        n_workers = min(workers, len(blocks), len(items))
+        if n_workers <= 1:
+            for lane in items:
+                run_trial(next_tid(), lane, g, blocks[0])
+            continue
         # Concurrent same-size trials on DISJOINT blocks (the reference's
         # Ray fan-out, ``:74-84``, without Ray): a bounded pool per size
         # class, each in-flight trial holding its own block from a free list.
-        by_size: dict = {}
-        for tid, item in enumerate(grid):
-            by_size.setdefault(item[1], []).append((tid, item))
-        for g, items in by_size.items():
-            blocks = topo.blocks(g)
-            n_workers = min(workers, len(blocks), len(items))
-            if n_workers <= 1:
-                for tid, (task, g_, name, tech) in items:
-                    run_trial(tid, task, g_, name, tech, blocks[0])
+        free: queue.Queue = queue.Queue()
+        for b in blocks[:n_workers]:
+            free.put(b)
+
+        def with_block(lane):
+            block = free.get()
+            try:
+                run_trial(next_tid(), lane, g, block)
+            finally:
+                free.put(block)
+
+        with ThreadPoolExecutor(
+            max_workers=n_workers, thread_name_prefix=f"trial-g{g}"
+        ) as pool:
+            futures = [pool.submit(with_block, lane) for lane in items]
+            for f in futures:
+                f.result()
+
+    # ------------------------------------------------------- cost-model fill
+    # Remaining grid points get interpolated strategies from the Amdahl fit
+    # over this lane's measured feasible points — flagged so the realized
+    # feedback loop knows to upgrade them. Points below a memory floor stay
+    # infeasible (their per-chip memory is >= an XLA-rejected size's); lanes
+    # with fewer than two measured points have no scaling signal and leave
+    # the dummy seeding below to mark the gap.
+    for lane in lanes:
+        if not lane.to_fill:
+            continue
+        pts = [
+            (g, pbt)
+            for g, (feasible, _params, pbt, source) in lane.done.items()
+            if feasible and source != "interpolated"
+        ]
+        model = _fit_scaling_model(pts) if len(pts) >= 2 else None
+        for g in lane.to_fill:
+            if lane.pruned(g):
+                prune_point(lane, g, "memory_monotone", planned=False)
                 continue
-            free: queue.Queue = queue.Queue()
-            for b in blocks[:n_workers]:
-                free.put(b)
+            if model is None:
+                continue
+            # Feasibility is only trusted between measured feasible sizes:
+            # extrapolating below the smallest one would claim memory room
+            # no trial ever checked.
+            lo = min(p[0] for p in pts)
+            if g < lo:
+                continue
+            per_batch = max(float(model(g)), 1e-9)
+            nearest = min(pts, key=lambda p: abs(p[0] - g))[0]
+            params = dict(lane.done[nearest][1] or {})
+            with update_lock:
+                lane.done[g] = (True, params, per_batch, "interpolated")
+            install(lane, g, params, per_batch, "interpolated")
+            metrics.event(
+                "trial_interpolated", task=lane.task.name, size=g,
+                technique=lane.name, per_batch_s=per_batch,
+                anchor_size=nearest,
+            )
 
-            def with_block(tid, task, g_, name, tech):
-                block = free.get()
-                try:
-                    run_trial(tid, task, g_, name, tech, block)
-                finally:
-                    free.put(block)
-
-            with ThreadPoolExecutor(
-                max_workers=n_workers, thread_name_prefix=f"trial-g{g}"
-            ) as pool:
-                futures = [
-                    pool.submit(with_block, tid, task, g_, name, tech)
-                    for tid, (task, g_, name, tech) in items
-                ]
-                for f in futures:
-                    f.result()
+    if eta.planned or n_hits:
+        logger.info(
+            "trial runner: sweep complete — %d trials run, %d cache hits, "
+            "%d pruned, %d interpolated",
+            eta.completed, n_hits, eta.pruned,
+            sum(1 for l in lanes for d in l.done.values() if d[3] == "interpolated"),
+        )
 
     # Seed unsearched sizes with an infeasible dummy (``:96-99``) so the
     # solver's bookkeeping sees a complete table.

@@ -1,0 +1,370 @@
+"""Persistent profile cache: trial results that outlive the driver process.
+
+Profiling is the single most expensive phase of the pipeline — compile
+dominates a trial (~1 min upper bound each, ``trial_runner/evaluator.py``) and
+the grid is (task × sub-mesh size × technique). The Saturn paper notes this
+cost is amortizable: a profile depends only on *what* is being timed (model,
+data shape, optimizer, technique, sub-mesh size, accelerator topology, XLA
+version), none of which changes between back-to-back sweeps. So every trial
+outcome — feasible (params + per-batch seconds) or infeasible — is keyed on a
+content fingerprint of exactly those inputs and written to one JSON file per
+key. A repeated ``search()`` over an unchanged task list then performs zero
+trial compiles.
+
+Entries are upgraded in place by the orchestrator's realized-feedback loop
+(``executor/orchestrator.py``): once a task actually runs, its measured
+per-batch time replaces the trial estimate (``source="realized"``), so the
+next process's sweep starts from production numbers, not solo-trial ones.
+
+Corrupt, stale or partially-written files are treated as misses, never
+errors: writes go through an atomic ``os.replace`` and reads re-validate the
+embedded key and field types. Delete the cache directory to invalidate
+everything.
+
+Environment:
+
+- ``SATURN_TPU_PROFILE_CACHE_DIR``: cache directory (default
+  ``~/.cache/saturn_tpu/profiles``).
+- ``SATURN_TPU_PROFILE_CACHE=0``: disable the default cache entirely.
+- ``SATURN_TPU_COMPILE_CACHE_DIR``: additionally enable JAX's persistent
+  *compilation* cache rooted there, so the XLA executables built by trial
+  sweeps are reused by the execution engine's bundle build
+  (``parallel/spmd_base.py::_build_uncached``) and by later processes.
+  Off by default: on CPU test platforms a cache shared across execution
+  contexts with different feature detection can load mismatched entries
+  (see ``tests/conftest.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("saturn_tpu")
+
+#: Bump when the fingerprint payload or entry schema changes meaning —
+#: old entries then miss instead of being misread.
+SCHEMA_VERSION = 1
+
+_ENV_DIR = "SATURN_TPU_PROFILE_CACHE_DIR"
+_ENV_TOGGLE = "SATURN_TPU_PROFILE_CACHE"
+_ENV_COMPILE_DIR = "SATURN_TPU_COMPILE_CACHE_DIR"
+
+_FALSEY = ("0", "false", "off", "no")
+
+
+# --------------------------------------------------------------- fingerprints
+def _model_signature(task: Any) -> str:
+    """Content signature of the task's model: config + abstract param tree.
+
+    Uses ``jax.eval_shape`` via ``ModelSpec.abstract_init`` so no weights are
+    materialized (the reference's lazy-instantiation rule, ``Task.py:92-97``).
+    Factories that fail or specs without the ModelSpec surface degrade to
+    whatever stable repr is available — a narrower key, never a wrong hit.
+    """
+    try:
+        spec = task.get_model()
+    except Exception:
+        return f"factory:{type(task).__name__}"
+    parts = [repr(getattr(spec, "config", type(spec).__name__))]
+    abstract = getattr(spec, "abstract_init", None)
+    if callable(abstract):
+        try:
+            import jax
+
+            leaves, _ = jax.tree_util.tree_flatten_with_path(abstract())
+            parts += [
+                f"{jax.tree_util.keystr(path)}:{tuple(leaf.shape)}:{leaf.dtype}"
+                for path, leaf in leaves
+            ]
+        except Exception:
+            pass
+    return ";".join(parts)
+
+
+def _data_signature(task: Any) -> str:
+    """Batch shape/dtype + batch size: what actually drives step time (token
+    *values* don't — synthetic vs real corpora profile identically)."""
+    try:
+        ds = task.get_dataset()
+    except Exception:
+        return "none"
+    parts = [type(ds).__name__, str(getattr(ds, "batch_size", None))]
+    eb = getattr(ds, "example_batch", None)
+    if callable(eb):
+        try:
+            b = eb()
+            parts += [str(tuple(getattr(b, "shape", ()))), str(getattr(b, "dtype", ""))]
+        except Exception:
+            pass
+    return ";".join(parts)
+
+
+def _optimizer_signature(task: Any) -> str:
+    opt = getattr(getattr(task, "hparams", None), "optimizer", None)
+    if isinstance(opt, str) or opt is None:
+        return str(opt)
+    # a custom optax factory: the qualname is the best stable handle (repr
+    # would embed a memory address and never match across processes)
+    return f"custom:{getattr(opt, '__qualname__', type(opt).__name__)}"
+
+
+def task_signature(task: Any) -> str:
+    """Everything about a *task* that a per-batch profile depends on.
+
+    Excludes lr, total batch count and the task name: the reference cloned
+    searched tasks across learning rates precisely because lr doesn't change
+    step time (``WikiText103.py:87-99``), and runtime is re-derived as
+    ``per_batch_time * total_batches`` at use time.
+    """
+    hp = getattr(task, "hparams", None)
+    kwargs = dict(getattr(hp, "kwargs", {}) or {})
+    hints = dict(getattr(task, "hints", {}) or {})
+    return json.dumps(
+        {
+            "model": _model_signature(task),
+            "data": _data_signature(task),
+            "optimizer": _optimizer_signature(task),
+            "kwargs": kwargs,
+            "hints": hints,
+        },
+        sort_keys=True,
+        default=repr,
+    )
+
+
+def topology_signature(topo: Any) -> str:
+    sig = getattr(topo, "signature", None)
+    return sig() if callable(sig) else repr(topo)
+
+
+def fingerprint(task_sig: str, technique: str, size: int, topo_sig: str) -> str:
+    """Cache key for one (task, technique, sub-mesh size) grid point."""
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:
+        jax_version = "none"
+    payload = json.dumps(
+        {
+            "schema": SCHEMA_VERSION,
+            "task": task_sig,
+            "technique": technique,
+            "size": int(size),
+            "topology": topo_sig,
+            "jax": jax_version,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+# --------------------------------------------------------------------- store
+class ProfileCache:
+    """Directory of one-JSON-file-per-key trial outcomes."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.json")
+
+    def get(self, key: Optional[str]) -> Optional[Dict[str, Any]]:
+        """Validated entry dict, or None for missing/corrupt/foreign files."""
+        if not key:
+            return None
+        try:
+            with open(self._path(key)) as f:
+                e = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(e, dict) or e.get("key") != key:
+            return None  # stale schema or hash collision artifact: miss
+        if not isinstance(e.get("feasible"), bool):
+            return None
+        if e["feasible"]:
+            pbt = e.get("per_batch_time")
+            if not isinstance(pbt, (int, float)) or pbt <= 0.0:
+                return None
+            if not isinstance(e.get("params"), dict):
+                return None
+        return e
+
+    def put(
+        self,
+        key: Optional[str],
+        *,
+        technique: str,
+        size: int,
+        feasible: bool,
+        params: Optional[Dict[str, Any]] = None,
+        per_batch_time: Optional[float] = None,
+        source: str = "trial",
+        memory_infeasible: bool = False,
+    ) -> bool:
+        """Atomically write one entry; False if the key or params aren't
+        cacheable (non-JSON params from a plugin technique)."""
+        if not key:
+            return False
+        entry = {
+            "key": key,
+            "schema": SCHEMA_VERSION,
+            "technique": technique,
+            "size": int(size),
+            "feasible": bool(feasible),
+            "params": params,
+            "per_batch_time": per_batch_time,
+            "source": source,
+            "memory_infeasible": bool(memory_infeasible),
+            "written": time.time(),
+        }
+        try:
+            blob = json.dumps(entry)
+        except (TypeError, ValueError):
+            return False
+        tmp = self._path(key) + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, self._path(key))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        return True
+
+    def note_realized(
+        self,
+        key: Optional[str],
+        per_batch_time: float,
+        params: Optional[Dict[str, Any]],
+        technique: str,
+        size: int,
+    ) -> bool:
+        """Upgrade (or create) an entry from a *realized* interval measurement.
+
+        Realized numbers supersede both trial profiles and interpolated
+        estimates: they average a whole interval of production batches under
+        real contention, which is exactly what the next sweep should predict.
+        """
+        if not key or per_batch_time <= 0.0:
+            return False
+        prev = self.get(key)
+        if prev is not None and prev.get("feasible") and params is None:
+            params = prev.get("params")
+        return self.put(
+            key,
+            technique=technique,
+            size=size,
+            feasible=True,
+            params=params if isinstance(params, dict) else {},
+            per_batch_time=float(per_batch_time),
+            source="realized",
+        )
+
+    def __len__(self) -> int:
+        try:
+            return sum(1 for fn in os.listdir(self.root) if fn.endswith(".json"))
+        except OSError:
+            return 0
+
+
+# ------------------------------------------------------------- default cache
+_DEFAULT: Optional[ProfileCache] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_dir() -> str:
+    return os.environ.get(
+        _ENV_DIR, os.path.join(os.path.expanduser("~"), ".cache", "saturn_tpu", "profiles")
+    )
+
+
+def default_cache() -> Optional[ProfileCache]:
+    """Process-wide cache honoring the env toggles; None when disabled."""
+    if os.environ.get(_ENV_TOGGLE, "1").lower() in _FALSEY:
+        return None
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        d = default_dir()
+        if _DEFAULT is None or _DEFAULT.root != d:
+            try:
+                _DEFAULT = ProfileCache(d)
+            except OSError:
+                log.warning("profile cache dir %s not writable — caching off", d)
+                return None
+        return _DEFAULT
+
+
+def resolve(spec: Any = None) -> Optional[ProfileCache]:
+    """Map a ``search(profile_cache=...)`` argument to a cache instance.
+
+    ``None`` -> the env-configured default (on unless disabled); ``False`` ->
+    off for this sweep; a path string -> that directory; a ``ProfileCache``
+    -> itself.
+    """
+    if spec is None:
+        return default_cache()
+    if spec is False:
+        return None
+    if isinstance(spec, ProfileCache):
+        return spec
+    if isinstance(spec, (str, os.PathLike)):
+        try:
+            return ProfileCache(os.fspath(spec))
+        except OSError:
+            log.warning("profile cache dir %s not writable — caching off", spec)
+            return None
+    raise TypeError(
+        f"profile_cache must be None, False, a directory path or a "
+        f"ProfileCache, got {type(spec).__name__}"
+    )
+
+
+# -------------------------------------------------- JAX compilation cache
+_COMPILE_CACHE_STATE = {"decided": False}
+
+
+def maybe_enable_persistent_compile_cache(path: Optional[str] = None) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` (or the env dir).
+
+    Idempotent and cheap on the no-op path, so callers on the build hot path
+    (``SPMDTechnique._build_uncached``) can invoke it unconditionally. The
+    decision is made once per process: flipping the env var mid-run would
+    otherwise mix cache roots inside one JAX runtime.
+    """
+    if _COMPILE_CACHE_STATE["decided"] and path is None:
+        return _COMPILE_CACHE_STATE.get("enabled", False)
+    explicit = path is not None
+    path = path or os.environ.get(_ENV_COMPILE_DIR)
+    if not explicit:
+        _COMPILE_CACHE_STATE["decided"] = True
+    if not path:
+        _COMPILE_CACHE_STATE["enabled"] = False
+        return False
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Trials compile many small programs; default thresholds would skip
+        # most of them and the cache would never amortize the sweep.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        log.warning("could not enable jax compilation cache at %s", path, exc_info=True)
+        _COMPILE_CACHE_STATE["enabled"] = False
+        return False
+    _COMPILE_CACHE_STATE["decided"] = True
+    _COMPILE_CACHE_STATE["enabled"] = True
+    log.info("jax persistent compilation cache enabled at %s", path)
+    return True

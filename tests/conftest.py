@@ -11,7 +11,54 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
-if "collective_call_terminate_timeout" not in os.environ["XLA_FLAGS"]:
+def _supports_collective_timeout_flag() -> bool:
+    """Does this jaxlib's XLA know the collective-timeout flag?
+
+    XLA FATALLY aborts on unknown XLA_FLAGS at first backend init
+    (``parse_flags_from_env.cc``), which would take down the whole suite at
+    the first test that touches a device — so probe in a subprocess first.
+    The verdict is cached in a tmp sentinel keyed on the jaxlib version
+    (the probe costs a ~3s jax import).
+    """
+    import json
+    import subprocess
+    import sys
+    import tempfile
+
+    import jaxlib.version
+
+    sentinel = os.path.join(tempfile.gettempdir(), "saturn_xla_flag_probe.json")
+    try:
+        with open(sentinel) as f:
+            rec = json.load(f)
+        if rec.get("jaxlib") == jaxlib.version.__version__:
+            return bool(rec["supported"])
+    except (OSError, ValueError, KeyError):
+        pass
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_cpu_collective_call_terminate_timeout_seconds=600"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        capture_output=True,
+        env=env,
+        timeout=120,
+    )
+    ok = r.returncode == 0
+    try:
+        tmp = f"{sentinel}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"jaxlib": jaxlib.version.__version__, "supported": ok}, f)
+        os.replace(tmp, sentinel)
+    except OSError:
+        pass
+    return ok
+
+
+if (
+    "collective_call_terminate_timeout" not in os.environ["XLA_FLAGS"]
+    and _supports_collective_timeout_flag()
+):
     # 8 emulated devices = 8 collective threads timesharing this host's ONE
     # core: XLA's default 40s cross-module-collective rendezvous abort
     # ("Termination timeout ... Exiting") fires spuriously under load
