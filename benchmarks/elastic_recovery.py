@@ -1,0 +1,134 @@
+"""Elastic recovery latency: detect -> replan -> resume, hardware-free.
+
+Runs a 3-task batch on the 8 virtual CPU devices, injects a 4-device slice
+preemption mid-interval, and measures how long the fleet takes to get work
+running again on the surviving mesh:
+
+- **detect**: the ``topology_change`` event (the orchestrator's pre-interval
+  poll observing the loss),
+- **replan**: the ``recovery`` event's ``replan_latency_s`` (topology diff +
+  strategy synthesis + solver re-run),
+- **resume**: the first technique launch after recovery.
+
+Prints ONE JSON line like ``bench.py``:
+
+    {"metric": "elastic_recovery_latency", "value": <detect->resume seconds>,
+     "unit": "s", "replan_s": ..., "policy": "pause-resolve-resume", ...}
+
+Run: ``python benchmarks/elastic_recovery.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.core.technique import BaseTechnique
+from saturn_tpu.executor import orchestrate
+from saturn_tpu.resilience import (
+    FaultEvent,
+    FaultInjector,
+    FaultKind,
+    FleetHealthMonitor,
+)
+from saturn_tpu.utils.metrics import read_events
+
+
+class FakeDev:
+    pass
+
+
+class TimestampingTech(BaseTechnique):
+    """Sleeps per batch, records the wall-clock time of every launch."""
+
+    name = "bench-fake"
+
+    def __init__(self, per_batch=0.005):
+        self.per_batch = per_batch
+        self.launches = []
+        self.lock = threading.Lock()
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        with self.lock:
+            self.launches.append(time.time())
+        time.sleep(self.per_batch * (override_batch_count or 1))
+
+    def search(self, task, devices, tid):
+        return {}, self.per_batch
+
+
+class FakeTask:
+    def __init__(self, name, total_batches, sizes, tech, pbt):
+        self.name = name
+        self.total_batches = total_batches
+        self.current_batch = 0
+        self.epoch_length = 1000
+        self.hints = {}
+        self.strategies = {
+            g: Strategy(tech, g, {}, pbt * total_batches, pbt) for g in sizes
+        }
+        self.selected_strategy = None
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+    def select_strategy(self, g):
+        self.selected_strategy = self.strategies[g]
+
+    def reconfigure(self, n):
+        self.current_batch = (self.current_batch + n) % self.epoch_length
+
+
+def main() -> None:
+    policy = os.environ.get("SATURN_TPU_RECOVERY_POLICY", "pause-resolve-resume")
+    topo = SliceTopology([FakeDev() for _ in range(8)])
+    monitor = FleetHealthMonitor.for_topology(topo)
+    tech = TimestampingTech(per_batch=0.005)
+    tasks = [FakeTask(f"job{i}", 80, [2, 4], tech, pbt=0.005) for i in range(3)]
+    injector = FaultInjector(schedule=[
+        FaultEvent(1, FaultKind.SLICE_PREEMPTION, devices=(4, 5, 6, 7),
+                   after_s=0.05),
+    ])
+    mpath = tempfile.mktemp(suffix=".jsonl")
+    try:
+        out = orchestrate(
+            tasks, interval=0.2, topology=topo, fault_injector=injector,
+            health_monitor=monitor, failure_policy="retry",
+            recovery_policy=policy, metrics_path=mpath,
+        )
+        if sorted(out["completed"]) != ["job0", "job1", "job2"]:
+            raise SystemExit(f"benchmark run lost work: {out}")
+        detect_ts = read_events(mpath, kind="topology_change")[0]["ts"]
+        recovery = read_events(mpath, kind="recovery")[0]
+        with tech.lock:
+            resume_ts = min(t for t in tech.launches if t > recovery["ts"])
+    finally:
+        if os.path.exists(mpath):
+            os.unlink(mpath)
+
+    print(json.dumps({
+        "metric": "elastic_recovery_latency",
+        "value": round(resume_ts - detect_ts, 6),
+        "unit": "s",
+        "replan_s": round(recovery["replan_latency_s"], 6),
+        "policy": policy,
+        "surviving_capacity": recovery["capacity"],
+        "n_tasks": recovery["n_tasks"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -148,6 +148,36 @@ class SliceTopology:
             s <<= 1
         return out
 
+    def subset(self, indices: Sequence[int]) -> "SliceTopology":
+        """A new topology over the surviving devices at ``indices`` (sorted,
+        re-indexed from 0) — the elastic replanner's shrink/grow primitive
+        (``resilience/replan.py``).
+
+        Slice boundaries are preserved where they survive intact: if every
+        original slice contributes the same power-of-two number of devices,
+        that count is the new ``slice_size``; otherwise the survivors form
+        one ICI domain (after losing part of a slice the contiguity
+        guarantee is gone anyway, and collectives must be assumed to cross
+        the reclaimed gap).
+        """
+        idx = sorted(set(indices))
+        if not idx:
+            raise ValueError("cannot build a topology over zero devices")
+        if idx[0] < 0 or idx[-1] >= len(self.devices):
+            raise ValueError(
+                f"device indices {idx[0]}..{idx[-1]} out of range for "
+                f"{len(self.devices)} devices"
+            )
+        devs = [self.devices[i] for i in idx]
+        per_slice: dict = {}
+        for i in idx:
+            per_slice.setdefault(i // self.slice_size, []).append(i)
+        sizes = {len(g) for g in per_slice.values()}
+        ss = None
+        if len(per_slice) > 1 and len(sizes) == 1 and _is_pow2(next(iter(sizes))):
+            ss = next(iter(sizes))
+        return SliceTopology(devs, slice_size=ss)
+
     def blocks(self, size: int) -> List[Block]:
         """All aligned blocks of a given size (the MILP's placement domain)."""
         if size not in self.valid_sizes():

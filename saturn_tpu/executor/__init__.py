@@ -1,0 +1,9 @@
+"""Execution layer: interval orchestration + gang engine.
+
+Public entry point: :func:`orchestrate` — run a task batch to completion
+under the MILP interval loop (``from saturn_tpu.executor import orchestrate``).
+"""
+
+from saturn_tpu.executor.orchestrator import orchestrate
+
+__all__ = ["orchestrate"]

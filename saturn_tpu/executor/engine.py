@@ -134,6 +134,9 @@ def execute(
     plan: Plan,
     topology: SliceTopology,
     failure_policy: str = "raise",
+    health=None,
+    faults=None,
+    interval_index: int = 0,
 ) -> Dict[str, BaseException]:
     """Gang-execute one interval (reference ``executor.py:88-129``).
 
@@ -148,6 +151,17 @@ def execute(
     the orchestrator can evict those tasks and keep the batch running —
     failure isolation the reference lacks (SURVEY.md §5 "no elasticity").
     Either way every other task finishes its interval first.
+
+    ``health`` (a ``resilience.FleetHealthMonitor``) turns on the elastic
+    hooks: per-block step timings feed straggler detection, and a device
+    that dies mid-interval (``faults`` watchdog, or a real platform notice)
+    aborts-and-requeues — not-yet-launched tasks and tasks whose block lost
+    a chip surface as ``PreemptedError`` (never raised even under
+    ``"raise"``: preemption is the fleet's fault, the orchestrator requeues
+    and replans). ``faults`` additionally injects this interval's scheduled
+    transient crashes and arms the mid-interval watchdog timers. Elastic
+    hooks are single-host only (the multi-host path ignores them; the
+    orchestrator refuses the combination up front).
     """
     from saturn_tpu.core import distributed
 
@@ -157,9 +171,18 @@ def execute(
 
     _check_disjoint(run_tasks, plan)
 
+    from saturn_tpu.resilience.faults import PreemptedError
+
     events = {t.name: threading.Event() for t in run_tasks}
     running = {t.name for t in run_tasks}
     errors: Dict[str, BaseException] = {}
+
+    abort = threading.Event()
+    timers = (
+        faults.arm_watchdog(interval_index, health, abort)
+        if faults is not None and health is not None
+        else []
+    )
 
     def launcher(task, tid: int):
         try:
@@ -167,19 +190,45 @@ def execute(
                 if dep in running:
                     events[dep].wait()
             a = plan.assignments[task.name]
-            task.select_strategy(a.apportionment)
             devices = topology.block_devices(a.block)
+            didx = health.indices_of(devices) if health is not None else []
+            if faults is not None and faults.crashes(task.name, interval_index):
+                raise RuntimeError(
+                    f"injected transient trial crash for {task.name}"
+                )
+            if abort.is_set() or (didx and health.any_lost(didx)):
+                # abort-and-requeue: the fleet changed under this interval —
+                # don't start work the replan will move anyway
+                raise PreemptedError(
+                    f"task {task.name} preempted before launch "
+                    f"(block [{a.block.offset}:{a.block.end}])"
+                )
+            task.select_strategy(a.apportionment)
             tech = task.selected_strategy.executor
             n = batches[task.name]
             logger.info(
                 "interval: launching %s on block [%d:%d] for %d batches",
                 task.name, a.block.offset, a.block.end, n,
             )
+            t_run = timeit.default_timer()
             tech.execute(task, devices, tid, override_batch_count=n)
+            dt_run = timeit.default_timer() - t_run
+            if didx and health.any_lost(didx):
+                # chips died under the run: the device state is gone, the
+                # work is discarded — the last checkpoint is ground truth
+                raise PreemptedError(
+                    f"task {task.name} lost devices mid-run "
+                    f"(block [{a.block.offset}:{a.block.end}])"
+                )
             task.reconfigure(n)  # data-cursor advance (``executor.py:84``)
+            if didx:
+                health.note_step(didx, dt_run / max(n, 1))
         except BaseException as e:  # surface after the barrier
             errors[task.name] = e
-            logger.exception("task %s failed during interval", task.name)
+            if isinstance(e, PreemptedError):
+                logger.warning("%s", e)
+            else:
+                logger.exception("task %s failed during interval", task.name)
         finally:
             events[task.name].set()
 
@@ -192,17 +241,30 @@ def execute(
         th.start()
     for th in threads:
         th.join()
+    for tm in timers:
+        tm.cancel()
     elapsed = timeit.default_timer() - t0
     metrics.event(
         "interval",
         elapsed_s=elapsed,
         planned_s=interval,
         n_tasks=len(run_tasks),
-        failed=sorted(errors),
+        failed=sorted(
+            n for n, e in errors.items() if not isinstance(e, PreemptedError)
+        ),
+        preempted=sorted(
+            n for n, e in errors.items() if isinstance(e, PreemptedError)
+        ),
     )
-    if errors and failure_policy == "raise":
-        name, err = next(iter(errors.items()))
-        raise RuntimeError(f"interval execution failed for task {name}") from err
+    if failure_policy == "raise":
+        real = {
+            n: e for n, e in errors.items() if not isinstance(e, PreemptedError)
+        }
+        if real:
+            name, err = next(iter(real.items()))
+            raise RuntimeError(
+                f"interval execution failed for task {name}"
+            ) from err
     # estimate-error feedback (``executor.py:126-129``)
     if elapsed > interval:
         logger.info("interval overran: %.1fs vs planned %.1fs", elapsed, interval)

@@ -38,6 +38,10 @@ def orchestrate(
     max_task_retries: int = 1,
     metrics_path: Optional[str] = None,
     trace_dir: Optional[str] = None,
+    fault_injector=None,
+    health_monitor=None,
+    recovery_policy: str = "pause-resolve-resume",
+    replan_degrade_factor: float = 2.0,
 ) -> dict:
     """Run every task to completion, minimizing batch makespan.
 
@@ -50,6 +54,17 @@ def orchestrate(
     from its last checkpoint at the next interval — then evict like
     ``"drop"``). ``metrics_path`` appends JSONL events (``utils/metrics.py``);
     ``trace_dir`` wraps the run in a jax.profiler trace.
+
+    Elasticity (``saturn_tpu.resilience``): passing ``health_monitor`` (a
+    ``FleetHealthMonitor``) — or a ``fault_injector`` / setting
+    ``SATURN_TPU_FAULTS`` — turns the fixed-topology loop elastic. Each
+    interval starts with a health poll; on a shrink/grow
+    ``TopologyChange`` the ``ElasticReplanner`` rebuilds topology + plan
+    over the surviving mesh under ``recovery_policy``
+    (``resilience.RECOVERY_POLICIES``). Mid-interval device loss
+    aborts-and-requeues the affected tasks (``PreemptedError`` — requeued
+    WITHOUT counting against ``max_task_retries``); migrated tasks resume
+    from their checkpoints on the new mesh. Single-host only.
 
     Returns ``{"completed": [names], "failed": {name: error string}}``.
     """
@@ -71,6 +86,30 @@ def orchestrate(
             "multi-host orchestration supports failure_policy='raise' only"
         )
     topo = topology if topology is not None else SliceTopology()
+
+    if fault_injector is None:
+        from saturn_tpu.resilience.faults import FaultInjector
+
+        fault_injector = FaultInjector.from_env()
+    if fault_injector is not None and health_monitor is None:
+        from saturn_tpu.resilience.health import FleetHealthMonitor
+
+        health_monitor = FleetHealthMonitor.for_topology(topo)
+    replanner = None
+    if health_monitor is not None:
+        if distributed.is_multihost():
+            # Elastic recovery mutates topology/plan from one process's
+            # health view; until changes are broadcast like plans are, a
+            # divergent topology means divergent collective programs.
+            raise ValueError(
+                "elastic resilience (health_monitor/fault_injector) is "
+                "single-host only"
+            )
+        from saturn_tpu.resilience.replan import ElasticReplanner
+
+        replanner = ElasticReplanner(
+            policy=recovery_policy, degrade_factor=replan_degrade_factor
+        )
     names = [t.name for t in task_list]
     if len(set(names)) != len(names):
         dupes = sorted({n for n in names if names.count(n) > 1})
@@ -94,6 +133,7 @@ def orchestrate(
             task_list, topo, interval, threshold, tlimit, failure_policy,
             max_task_retries, metrics_path, trace_dir,
             all_completed, all_failed, retries,
+            health_monitor, fault_injector, replanner,
         )
     finally:
         import sys
@@ -148,12 +188,59 @@ def _persist_realized(task) -> None:
                      exc_info=True)
 
 
+def _handle_topology_change(
+    task_list, base_topo, health, replanner, change, plan, tlimit,
+    all_failed,
+):
+    """Pre-interval elastic hook: rebuild topology + plan over the monitor's
+    surviving device set, evict the unschedulable, release migrated tasks'
+    live device state so their next interval restores from checkpoint on
+    the new mesh (cross-mesh migration, ``utils/checkpoint.py``)."""
+    import timeit as _timeit
+
+    t_detect = _timeit.default_timer()
+    metrics.event("topology_change", **change.to_fields())
+    logger.warning(
+        "topology change (%s): lost=%s gained=%s stragglers=%s — replanning",
+        change.kind, change.lost, change.gained, change.stragglers,
+    )
+    result = replanner.replan(
+        task_list, base_topo, health.alive_indices(), change,
+        previous_plan=plan, time_limit=tlimit,
+    )
+    evicted = set(result.evicted)
+    for name in sorted(evicted):
+        all_failed[name] = f"evicted on topology change ({change.kind})"
+        metrics.event("task_failed", task=name,
+                      error=f"evicted on topology change ({change.kind})")
+    by_name = {t.name: t for t in task_list}
+    for name, d in sorted(result.migrations.items()):
+        if not d["moved"] or name in evicted:
+            continue
+        t = by_name.get(name)
+        if t is not None:
+            release = getattr(t, "release_live_state", None)
+            if release is not None:
+                release()  # next interval restores from ckpt on the new mesh
+        metrics.event("migration", task=name, moved_from=d["from"],
+                      moved_to=d["to"])
+    task_list = [t for t in task_list if t.name not in evicted]
+    metrics.event(
+        "recovery", policy=replanner.policy,
+        replan_latency_s=_timeit.default_timer() - t_detect,
+        capacity=result.topology.capacity, n_tasks=len(task_list),
+    )
+    return task_list, result.topology, result.plan
+
+
 def _orchestrate_loop(
     task_list, topo, interval, threshold, tlimit, failure_policy,
     max_task_retries, metrics_path, trace_dir,
     all_completed, all_failed, retries,
+    health=None, faults=None, replanner=None,
 ) -> dict:
     from saturn_tpu.core import distributed
+    from saturn_tpu.resilience.faults import PreemptedError
 
     multihost = distributed.is_multihost()
     if multihost and not distributed.is_coordinator():
@@ -184,8 +271,30 @@ def _orchestrate_loop(
         logger.info("initial plan: makespan %.1fs, %d tasks", plan.makespan, len(task_list))
         metrics.event("solve", makespan_s=plan.makespan, n_tasks=len(task_list))
 
+        base_topo = topo  # health-monitor indices refer to the pre-fault fleet
+        interval_index = 0
         with ThreadPoolExecutor(max_workers=1, thread_name_prefix="solver") as pool:
             while task_list:
+                if health is not None:
+                    # Pre-interval health poll (elastic hook point): apply
+                    # scheduled interval-start faults, then consume at most
+                    # one aggregated TopologyChange into a replan.
+                    if faults is not None:
+                        faults.apply_due(interval_index, health)
+                    change = health.poll()
+                    if change is not None and change.kind in ("shrink", "grow"):
+                        task_list, topo, plan = _handle_topology_change(
+                            task_list, base_topo, health, replanner, change,
+                            plan, tlimit, all_failed,
+                        )
+                        if not task_list:
+                            break
+                    elif change is not None:  # degrade: advisory, no replan
+                        metrics.event("topology_change", **change.to_fields())
+                        logger.warning(
+                            "degraded fleet: stragglers %s (policy %s keeps "
+                            "running)", change.stragglers, replanner.policy,
+                        )
                 run_tasks, batches, completed = engine.forecast(task_list, interval, plan)
                 remaining = [t for t in task_list if t not in completed]
 
@@ -209,6 +318,8 @@ def _orchestrate_loop(
                     errors = engine.execute(
                         run_tasks, batches, interval, plan, topo,
                         failure_policy="raise" if failure_policy == "raise" else "drop",
+                        health=health, faults=faults,
+                        interval_index=interval_index,
                     )
                 elif remaining:
                     # nothing scheduled inside this interval (all starts beyond
@@ -299,6 +410,42 @@ def _orchestrate_loop(
                             "per batch", name, old, new,
                         )
 
+                preempted = {
+                    n: e for n, e in errors.items()
+                    if isinstance(e, PreemptedError)
+                }
+                if preempted:
+                    # Abort-and-requeue: preemption is the fleet's fault, not
+                    # the task's — roll back forecast's accounting and requeue
+                    # WITHOUT counting against max_task_retries; the next
+                    # loop-top health poll replans onto the surviving mesh
+                    # and the task resumes from its checkpoint there.
+                    errors = {
+                        n: e for n, e in errors.items() if n not in preempted
+                    }
+                    by_name = {t.name: t for t in run_tasks}
+                    for name, err in sorted(preempted.items()):
+                        t = by_name[name]
+                        release = getattr(t, "release_live_state", None)
+                        if release is not None:
+                            release()  # device state died with the chips
+                        n = batches.get(name, 0)
+                        t.total_batches += n
+                        for s in t.strategies.values():
+                            if s.feasible:
+                                s.runtime = s.per_batch_time * t.total_batches
+                        metrics.event("task_preempted", task=name,
+                                      error=repr(err))
+                        logger.warning(
+                            "task %s preempted — requeued for replan: %r",
+                            name, err,
+                        )
+                        if t not in remaining:
+                            remaining.append(t)  # was forecast-completed
+                    completed = [
+                        t for t in completed if t.name not in preempted
+                    ]
+
                 if errors:  # "drop": evict failed tasks; "retry": give them
                     # max_task_retries more intervals first
                     by_name = {t.name: t for t in run_tasks}
@@ -359,6 +506,7 @@ def _orchestrate_loop(
                     if release_c is not None:
                         release_c()  # and their compiled programs
                 task_list = remaining
+                interval_index += 1
     logger.info("orchestration complete (%d completed, %d failed)",
                 len(all_completed), len(all_failed))
     return {"completed": all_completed, "failed": all_failed}

@@ -1,0 +1,46 @@
+"""Elastic resilience: fault injection, fleet health, topology-aware replanning.
+
+The reference assumed a static device fleet for the lifetime of a batch
+(SURVEY.md §5 "no elasticity, no fault injection"); on real TPU fleets
+preemption of spot slices is the dominant failure mode. This package turns
+the orchestrator's fixed-topology interval loop into an elastic one:
+
+- :mod:`saturn_tpu.resilience.faults` — deterministic, seeded fault
+  injection (device loss, slice preemption, stragglers, transient trial
+  crashes) so elasticity is testable on CPU with
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+- :mod:`saturn_tpu.resilience.health` — per-device liveness/latency tracking
+  fed by engine step timings; raises typed :class:`TopologyChange` events.
+- :mod:`saturn_tpu.resilience.replan` — on a shrink/grow event, diffs the
+  ``SliceTopology``, re-invokes the SPASE solver over the surviving mesh
+  (Amdahl-interpolating never-profiled sizes) under a pluggable recovery
+  policy.
+
+Cross-mesh checkpoint migration (restoring a task's state onto a mesh of a
+different shape than it was saved under) lives in
+``saturn_tpu.utils.checkpoint.restore_sharded`` — resharding is one
+``jax.device_put`` against the new sharding spec.
+"""
+
+from saturn_tpu.resilience.faults import (
+    FaultEvent,
+    FaultInjector,
+    FaultKind,
+    PreemptedError,
+    seeded_schedule,
+)
+from saturn_tpu.resilience.health import DeviceHealth, FleetHealthMonitor, TopologyChange
+from saturn_tpu.resilience.replan import RECOVERY_POLICIES, ElasticReplanner
+
+__all__ = [
+    "FaultEvent",
+    "FaultInjector",
+    "FaultKind",
+    "PreemptedError",
+    "seeded_schedule",
+    "DeviceHealth",
+    "FleetHealthMonitor",
+    "TopologyChange",
+    "ElasticReplanner",
+    "RECOVERY_POLICIES",
+]

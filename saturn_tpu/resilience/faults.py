@@ -1,0 +1,250 @@
+"""Deterministic fault injection for elasticity testing.
+
+Real failure detection on a TPU fleet comes from the platform (XLA aborts,
+coordination-service timeouts, preemption notices). None of that is
+exercisable in CI, so this module simulates the same *observable effects*
+from a deterministic schedule: device loss, whole-slice preemption,
+slow-straggler chips, and transient trial crashes. The schedule is either
+built programmatically, generated from a seed (:func:`seeded_schedule`), or
+parsed from ``SATURN_TPU_FAULTS`` — so a CPU run with
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` reproduces the exact
+same fault sequence every time.
+
+The injector never touches devices itself; it drives the
+:class:`~saturn_tpu.resilience.health.FleetHealthMonitor` (which the
+orchestrator polls) and answers the engine's per-task crash queries. The
+split mirrors production: a real deployment replaces THIS module with
+platform signals and keeps health/replan unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+
+class FaultKind:
+    """Fault taxonomy (string constants so schedules serialize trivially)."""
+
+    DEVICE_LOSS = "device_loss"          # individual chips vanish
+    SLICE_PREEMPTION = "slice_preemption"  # a whole aligned block vanishes
+    STRAGGLER = "straggler"              # chips slow down by `slowdown`x
+    TRIAL_CRASH = "trial_crash"          # one task's interval run raises once
+    DEVICE_RETURN = "device_return"      # previously lost chips come back
+
+    ALL = (DEVICE_LOSS, SLICE_PREEMPTION, STRAGGLER, TRIAL_CRASH, DEVICE_RETURN)
+
+
+class PreemptedError(RuntimeError):
+    """A task's interval run was lost to a device/slice preemption.
+
+    Distinct from an ordinary task failure: the orchestrator requeues a
+    preempted task WITHOUT counting it against ``max_task_retries`` — losing
+    your chips is the fleet's fault, not the task's.
+    """
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault.
+
+    ``at_interval`` is the orchestrator interval index (0-based) the event
+    fires in; ``after_s`` delays it that many seconds INTO the interval
+    (0.0 = fires at the pre-interval health poll, >0 = mid-interval, applied
+    by the engine's watchdog timer).
+    """
+
+    at_interval: int
+    kind: str
+    devices: Tuple[int, ...] = ()        # device indices (loss/preemption/straggler/return)
+    task: Optional[str] = None           # TRIAL_CRASH target; None = any task
+    slowdown: float = 1.0                # STRAGGLER latency multiplier
+    after_s: float = 0.0                 # seconds into the interval
+
+    def __post_init__(self) -> None:
+        if self.kind not in FaultKind.ALL:
+            raise ValueError(f"unknown fault kind {self.kind!r}; use one of {FaultKind.ALL}")
+
+    @property
+    def mid_interval(self) -> bool:
+        return self.after_s > 0.0
+
+
+@dataclass
+class FaultInjector:
+    """Replays a fault schedule against the health monitor and the engine.
+
+    One injector instance is single-use per orchestration: crash events are
+    consumed as they fire (a *transient* crash hits once, the retry
+    succeeds), and interval polls are idempotent within an interval.
+    """
+
+    schedule: List[FaultEvent] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self.schedule = sorted(
+            self.schedule, key=lambda e: (e.at_interval, e.after_s, e.kind)
+        )
+        self._consumed_crashes: set = set()
+
+    # ------------------------------------------------------------- interval
+    def due(self, interval_index: int, mid_interval: bool = False) -> List[FaultEvent]:
+        """Topology events due in this interval — at its start
+        (``mid_interval=False``, the orchestrator's pre-interval poll) or
+        during it (``True``, the engine's watchdog)."""
+        return [
+            e
+            for e in self.schedule
+            if e.at_interval == interval_index
+            and e.mid_interval == mid_interval
+            and e.kind != FaultKind.TRIAL_CRASH
+        ]
+
+    def apply_due(self, interval_index: int, monitor, mid_interval: bool = False) -> List[FaultEvent]:
+        """Apply every due topology event to ``monitor``; returns them."""
+        events = self.due(interval_index, mid_interval=mid_interval)
+        for e in events:
+            if e.kind in (FaultKind.DEVICE_LOSS, FaultKind.SLICE_PREEMPTION):
+                monitor.mark_lost(e.devices, cause=e.kind)
+            elif e.kind == FaultKind.DEVICE_RETURN:
+                monitor.mark_restored(e.devices)
+            elif e.kind == FaultKind.STRAGGLER:
+                monitor.mark_straggler(e.devices, e.slowdown)
+        return events
+
+    def arm_watchdog(self, interval_index: int, monitor, abort_event) -> List:
+        """Arm one timer per mid-interval event due this interval (the
+        engine's abort-and-requeue hook). Liveness events additionally set
+        ``abort_event`` so launcher threads stop starting new work; the
+        caller cancels unexpired timers when the interval ends."""
+        import threading
+
+        timers = []
+        for ev in self.due(interval_index, mid_interval=True):
+            def fire(ev=ev):
+                if ev.kind in (FaultKind.DEVICE_LOSS, FaultKind.SLICE_PREEMPTION):
+                    monitor.mark_lost(ev.devices, cause=ev.kind)
+                    abort_event.set()
+                elif ev.kind == FaultKind.STRAGGLER:
+                    monitor.mark_straggler(ev.devices, ev.slowdown)
+                elif ev.kind == FaultKind.DEVICE_RETURN:
+                    monitor.mark_restored(ev.devices)
+
+            t = threading.Timer(ev.after_s, fire)
+            t.daemon = True
+            t.start()
+            timers.append(t)
+        return timers
+
+    # ---------------------------------------------------------------- crash
+    def crashes(self, task_name: str, interval_index: int) -> bool:
+        """Should this task's run raise a transient crash this interval?
+        Each TRIAL_CRASH event fires exactly once (transient by definition —
+        the reference's retry-able trial failure class)."""
+        for i, e in enumerate(self.schedule):
+            if (
+                e.kind == FaultKind.TRIAL_CRASH
+                and e.at_interval == interval_index
+                and (e.task is None or e.task == task_name)
+                and i not in self._consumed_crashes
+            ):
+                self._consumed_crashes.add(i)
+                return True
+        return False
+
+    # ------------------------------------------------------------------ env
+    @classmethod
+    def from_env(cls, var: str = "SATURN_TPU_FAULTS") -> Optional["FaultInjector"]:
+        """Parse a schedule from the environment, or None if unset.
+
+        Format: semicolon-separated events
+        ``<interval>[+<after_s>]:<kind>:<spec>`` where ``spec`` is a device
+        range ``lo-hi`` / comma list for topology events, a task name for
+        ``trial_crash``, or ``devs@slowdown`` for ``straggler``. Example::
+
+            SATURN_TPU_FAULTS="1+0.05:slice_preemption:4-7;2:trial_crash:jobA"
+        """
+        raw = os.environ.get(var, "").strip()
+        if not raw:
+            return None
+        return cls(schedule=[_parse_event(tok) for tok in raw.split(";") if tok.strip()])
+
+
+def _parse_devices(spec: str) -> Tuple[int, ...]:
+    out: List[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            out.extend(range(int(lo), int(hi) + 1))
+        elif part:
+            out.append(int(part))
+    return tuple(out)
+
+
+def _parse_event(token: str) -> FaultEvent:
+    try:
+        when, kind, spec = token.strip().split(":", 2)
+        after_s = 0.0
+        if "+" in when:
+            when, after = when.split("+", 1)
+            after_s = float(after)
+        interval = int(when)
+        kind = kind.strip()
+        if kind == FaultKind.TRIAL_CRASH:
+            return FaultEvent(interval, kind, task=spec.strip() or None, after_s=after_s)
+        if kind == FaultKind.STRAGGLER:
+            devs, _, slow = spec.partition("@")
+            return FaultEvent(
+                interval, kind, devices=_parse_devices(devs),
+                slowdown=float(slow) if slow else 3.0, after_s=after_s,
+            )
+        return FaultEvent(interval, kind, devices=_parse_devices(spec), after_s=after_s)
+    except (ValueError, IndexError) as e:
+        raise ValueError(
+            f"bad SATURN_TPU_FAULTS event {token!r} "
+            "(expected '<interval>[+<after_s>]:<kind>:<spec>')"
+        ) from e
+
+
+def seeded_schedule(
+    seed: int,
+    n_intervals: int,
+    n_devices: int,
+    p_preempt: float = 0.15,
+    p_crash: float = 0.1,
+    p_straggler: float = 0.05,
+) -> List[FaultEvent]:
+    """Generate a reproducible random fault schedule.
+
+    Per interval, each fault class fires independently with its probability;
+    preemptions take an aligned power-of-two block (the unit real spot
+    reclaims operate on), stragglers a single chip. The same (seed, shape)
+    always yields the same schedule — chaos testing without flakes.
+    """
+    rng = random.Random(seed)
+    events: List[FaultEvent] = []
+    for i in range(n_intervals):
+        if rng.random() < p_preempt and n_devices >= 2:
+            size = 2 ** rng.randint(0, max(0, n_devices.bit_length() - 2))
+            offset = rng.randrange(0, n_devices // size) * size
+            events.append(
+                FaultEvent(
+                    i, FaultKind.SLICE_PREEMPTION,
+                    devices=tuple(range(offset, offset + size)),
+                    after_s=round(rng.uniform(0.0, 0.2), 3),
+                )
+            )
+        if rng.random() < p_crash:
+            events.append(FaultEvent(i, FaultKind.TRIAL_CRASH))
+        if rng.random() < p_straggler:
+            events.append(
+                FaultEvent(
+                    i, FaultKind.STRAGGLER,
+                    devices=(rng.randrange(n_devices),),
+                    slowdown=round(rng.uniform(2.0, 6.0), 2),
+                )
+            )
+    return events

@@ -1,0 +1,224 @@
+"""Fleet health: per-device liveness/latency tracking and typed change events.
+
+The engine feeds per-block step timings after every interval run
+(:func:`FleetHealthMonitor.note_step`); fault injection — or, on a real
+fleet, platform preemption notices — feeds liveness transitions
+(``mark_lost`` / ``mark_restored``). The orchestrator polls the monitor at
+its pre-interval hook and receives at most one aggregated
+:class:`TopologyChange` per poll, which it hands to the elastic replanner.
+
+Straggler detection is latency-based: a device whose EWMA per-batch latency
+exceeds ``straggler_factor`` x the fleet median is flagged, producing a
+``degrade`` event (advisory — the ``degrade-in-place`` recovery policy keeps
+running; an operator policy could evict instead).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class TopologyChange:
+    """One aggregated fleet-health transition, as consumed by the replanner.
+
+    ``kind``: ``"shrink"`` (devices lost), ``"grow"`` (devices returned;
+    wins only when nothing was lost in the same poll window), or
+    ``"degrade"`` (liveness unchanged, stragglers detected).
+    """
+
+    kind: str
+    lost: Tuple[int, ...] = ()
+    gained: Tuple[int, ...] = ()
+    stragglers: Tuple[int, ...] = ()
+    cause: str = ""
+    at: float = field(default_factory=time.time)
+
+    def to_fields(self) -> dict:
+        """Flat JSON-safe dict for the metrics stream."""
+        return {
+            "change": self.kind,
+            "lost": list(self.lost),
+            "gained": list(self.gained),
+            "stragglers": list(self.stragglers),
+            "cause": self.cause,
+        }
+
+
+@dataclass
+class DeviceHealth:
+    """Liveness + latency state for one device index."""
+
+    alive: bool = True
+    latency_ewma: Optional[float] = None   # seconds per batch, EWMA
+    slowdown: float = 1.0                  # injected straggler multiplier
+    last_seen: float = 0.0
+
+
+class FleetHealthMonitor:
+    """Tracks every device of a :class:`~saturn_tpu.core.mesh.SliceTopology`.
+
+    Thread-safe: engine launcher threads call :meth:`note_step` concurrently
+    and the mid-interval fault watchdog calls :meth:`mark_lost` from a timer
+    thread while the orchestrator polls from the main thread.
+    """
+
+    EWMA_ALPHA = 0.5  # latency observations are whole-interval averages
+
+    def __init__(self, n_devices: int, straggler_factor: float = 3.0):
+        if n_devices < 1:
+            raise ValueError("n_devices must be positive")
+        self.n_devices = n_devices
+        self.straggler_factor = straggler_factor
+        self._devices: Dict[int, DeviceHealth] = {
+            i: DeviceHealth() for i in range(n_devices)
+        }
+        self._lock = threading.Lock()
+        # Pending transitions since the last poll(), aggregated there.
+        self._pending_lost: set = set()
+        self._pending_gained: set = set()
+        self._pending_cause: str = ""
+        # id(device object) -> base index, set by for_topology/bind_devices.
+        # Monitor indices always refer to the BASE (pre-fault) topology, so
+        # fault schedules and metrics name stable device ids across shrinks;
+        # the engine translates current-topology device objects through this
+        # map (SliceTopology.subset reuses the same objects).
+        self._id_to_index: Optional[Dict[int, int]] = None
+
+    @classmethod
+    def for_topology(cls, topology, straggler_factor: float = 3.0) -> "FleetHealthMonitor":
+        """Monitor bound to a topology's device objects (the normal path)."""
+        m = cls(len(topology.devices), straggler_factor)
+        m.bind_devices(topology.devices)
+        return m
+
+    def bind_devices(self, devices: Sequence) -> None:
+        self._id_to_index = {id(d): i for i, d in enumerate(devices)}
+
+    def indices_of(self, devices: Sequence) -> List[int]:
+        """Base indices for a block's device objects ([] when unbound —
+        an index-only monitor, as in unit tests, stays inert here)."""
+        if self._id_to_index is None:
+            return []
+        return [
+            self._id_to_index[id(d)] for d in devices if id(d) in self._id_to_index
+        ]
+
+    # -------------------------------------------------------------- feeding
+    def note_step(self, device_indices: Sequence[int], per_batch_s: float) -> None:
+        """Fold one interval run's realized per-batch seconds into every
+        device of the block that ran it (the engine's post-run hook).
+        Injected straggler slowdowns inflate the observation, so detection
+        exercises the same code path real slow chips would."""
+        now = time.time()
+        with self._lock:
+            for i in device_indices:
+                d = self._devices.get(i)
+                if d is None or not d.alive:
+                    continue
+                obs = per_batch_s * d.slowdown
+                d.latency_ewma = (
+                    obs
+                    if d.latency_ewma is None
+                    else self.EWMA_ALPHA * obs + (1 - self.EWMA_ALPHA) * d.latency_ewma
+                )
+                d.last_seen = now
+
+    def mark_lost(self, device_indices: Sequence[int], cause: str = "device_loss") -> None:
+        with self._lock:
+            for i in device_indices:
+                d = self._devices.get(i)
+                if d is not None and d.alive:
+                    d.alive = False
+                    self._pending_lost.add(i)
+                    self._pending_gained.discard(i)
+            if cause:
+                self._pending_cause = cause
+
+    def mark_restored(self, device_indices: Sequence[int]) -> None:
+        with self._lock:
+            for i in device_indices:
+                d = self._devices.get(i)
+                if d is not None and not d.alive:
+                    d.alive = True
+                    d.latency_ewma = None  # returned chip: history is stale
+                    d.slowdown = 1.0
+                    self._pending_gained.add(i)
+                    self._pending_lost.discard(i)
+
+    def mark_straggler(self, device_indices: Sequence[int], slowdown: float) -> None:
+        """Injected slowdown (fault schedule); detection stays latency-based."""
+        with self._lock:
+            for i in device_indices:
+                d = self._devices.get(i)
+                if d is not None:
+                    d.slowdown = max(1.0, slowdown)
+
+    # -------------------------------------------------------------- queries
+    def alive_indices(self) -> List[int]:
+        with self._lock:
+            return [i for i, d in sorted(self._devices.items()) if d.alive]
+
+    def is_alive(self, index: int) -> bool:
+        with self._lock:
+            d = self._devices.get(index)
+            return d is not None and d.alive
+
+    def any_lost(self, device_indices: Sequence[int]) -> bool:
+        """Did any device of this block die? The engine's post-run check:
+        work computed on a block that lost a chip mid-interval is discarded
+        (the last checkpoint is the ground truth the task resumes from)."""
+        with self._lock:
+            return any(
+                (d := self._devices.get(i)) is None or not d.alive
+                for i in device_indices
+            )
+
+    def stragglers(self) -> List[int]:
+        """Devices whose latency EWMA exceeds straggler_factor x fleet
+        median (alive devices with at least one observation)."""
+        with self._lock:
+            obs = {
+                i: d.latency_ewma
+                for i, d in self._devices.items()
+                if d.alive and d.latency_ewma is not None
+            }
+        if len(obs) < 2:
+            return []
+        vals = sorted(obs.values())
+        median = vals[len(vals) // 2]
+        if median <= 0.0:
+            return []
+        return sorted(i for i, v in obs.items() if v > self.straggler_factor * median)
+
+    # ---------------------------------------------------------------- polls
+    def poll(self) -> Optional[TopologyChange]:
+        """Consume pending transitions into one aggregated event (or None).
+
+        Liveness changes win over straggler detection: a shrink forces a
+        replan regardless of latency noise. A poll window containing both
+        losses and returns reports ``shrink`` with both sets filled — the
+        replanner rebuilds from the full alive set either way.
+        """
+        with self._lock:
+            lost = tuple(sorted(self._pending_lost))
+            gained = tuple(sorted(self._pending_gained))
+            cause = self._pending_cause
+            self._pending_lost.clear()
+            self._pending_gained.clear()
+            self._pending_cause = ""
+        if lost:
+            return TopologyChange(
+                kind="shrink", lost=lost, gained=gained, cause=cause or "device_loss"
+            )
+        if gained:
+            return TopologyChange(kind="grow", gained=gained, cause=cause or "device_return")
+        stragglers = self.stragglers()
+        if stragglers:
+            return TopologyChange(
+                kind="degrade", stragglers=tuple(stragglers), cause="straggler"
+            )
+        return None

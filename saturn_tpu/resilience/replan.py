@@ -1,0 +1,288 @@
+"""Elastic replanning: shrink/grow the plan when the fleet changes shape.
+
+On a :class:`~saturn_tpu.resilience.health.TopologyChange` the orchestrator
+hands the surviving device set here. The replanner
+
+1. rebuilds the ``SliceTopology`` over the survivors
+   (``SliceTopology.subset``),
+2. makes every task schedulable on the new capacity — already-profiled
+   strategies are reused as-is; never-profiled sizes are synthesized from
+   the same Amdahl scaling model the trial runner's grid pruning uses
+   (``trial_runner/evaluator.py::_fit_scaling_model``), flagged
+   ``interpolated`` so the realized-feedback loop upgrades them once they
+   actually run,
+3. applies a pluggable **recovery policy** (Piper-style programmable
+   scheduling, arXiv 2606.11169) deciding who keeps running, and
+4. re-invokes the SPASE solver (``solver/milp.py``) over the surviving mesh.
+
+Built-in policies:
+
+``pause-resolve-resume``
+    Pause the batch, full blocking re-solve on the new topology, resume
+    everything that fits. The default; best plans, costs one solver run.
+``degrade-in-place``
+    No solver run: every task keeps its strategy *size* (clamped to the new
+    capacity) and is list-scheduled in previous start order. Cheapest
+    recovery latency; accepts a worse makespan.
+``evict-lowest-priority``
+    Like pause-resolve-resume, but first evicts the lowest-priority tasks
+    (``task.hints["priority"]``, default 0) until the projected makespan is
+    within ``degrade_factor`` x the pre-fault plan. Unschedulable tasks are
+    evicted under every policy.
+
+Custom policies register via :func:`register_policy` — a callable
+``(tasks, ctx) -> (keep, evict)``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.solver import milp
+from saturn_tpu.utils import metrics
+
+log = logging.getLogger("saturn_tpu")
+
+RECOVERY_POLICIES = (
+    "pause-resolve-resume",
+    "degrade-in-place",
+    "evict-lowest-priority",
+)
+
+
+@dataclass
+class ReplanContext:
+    """What a recovery policy gets to see."""
+
+    topology: SliceTopology            # the surviving mesh
+    previous_plan: Optional[milp.Plan]
+    previous_makespan: float
+    change_kind: str
+    degrade_factor: float
+
+
+@dataclass
+class ReplanResult:
+    topology: SliceTopology
+    plan: milp.Plan
+    evicted: List[str] = field(default_factory=list)
+    synthesized: Dict[str, List[int]] = field(default_factory=dict)  # task -> sizes
+    migrations: Dict[str, dict] = field(default_factory=dict)        # task -> diff
+
+
+_POLICIES: Dict[str, Callable] = {}
+
+
+def register_policy(name: str, fn: Callable) -> None:
+    """Register a custom recovery policy ``(tasks, ctx) -> (keep, evict)``."""
+    _POLICIES[name] = fn
+
+
+def _priority(task) -> float:
+    return float(getattr(task, "hints", {}).get("priority", 0.0))
+
+
+def _runnable(task, capacity: int) -> bool:
+    return any(g <= capacity for g in task.feasible_strategies())
+
+
+def _policy_resolve(tasks, ctx: ReplanContext):
+    return list(tasks), []
+
+
+def _policy_evict_lowest(tasks, ctx: ReplanContext):
+    """Drop low-priority work until the survivors' projected makespan is
+    within degrade_factor of the pre-fault plan (greedy projection — cheap
+    and pessimistic, so eviction errs toward keeping tasks)."""
+    keep = sorted(tasks, key=_priority, reverse=True)
+    evicted: List = []
+    limit = ctx.degrade_factor * max(ctx.previous_makespan, 1e-9)
+    while len(keep) > 1:
+        proj = milp.greedy_plan(keep, ctx.topology).makespan
+        if proj <= limit or ctx.previous_makespan <= 0.0:
+            break
+        evicted.append(keep.pop())  # lowest priority last after the sort
+    return keep, evicted
+
+
+_POLICIES["pause-resolve-resume"] = _policy_resolve
+_POLICIES["degrade-in-place"] = _policy_resolve  # selection identical; the
+#                                 difference is skipping the solver run below
+_POLICIES["evict-lowest-priority"] = _policy_evict_lowest
+
+
+class ElasticReplanner:
+    """Turns TopologyChange events into (new topology, new plan)."""
+
+    def __init__(
+        self,
+        policy: str = "pause-resolve-resume",
+        degrade_factor: float = 2.0,
+    ):
+        if policy not in _POLICIES:
+            raise ValueError(
+                f"unknown recovery policy {policy!r}; built-ins: {RECOVERY_POLICIES}, "
+                f"registered: {sorted(_POLICIES)}"
+            )
+        self.policy = policy
+        self.degrade_factor = degrade_factor
+
+    # ----------------------------------------------------------- strategies
+    def _synthesize(self, task, capacity: int) -> List[int]:
+        """Give ``task`` schedulable strategies at sizes <= capacity it was
+        never profiled at, from the Amdahl fit over its measured points.
+
+        Memory feasibility below the smallest measured size was never
+        checked (the trial runner refuses to extrapolate there for exactly
+        that reason) — a preemption forces the call anyway; the synthesized
+        strategy is flagged ``interpolated`` and an execution failure lands
+        in the ordinary retry/evict path.
+        """
+        from saturn_tpu.trial_runner.evaluator import _fit_scaling_model
+
+        feas = task.feasible_strategies()
+        pts = [(g, s.per_batch_time) for g, s in feas.items() if s.per_batch_time > 0]
+        if not pts:
+            return []
+        if len(pts) >= 2:
+            model = _fit_scaling_model(pts)
+        else:
+            g0, t0 = pts[0]
+            model = lambda g: t0 * g0 / float(g)  # pure-parallel: pessimistic on shrink
+        anchor_g = min(pts, key=lambda p: p[0])[0]
+        anchor = feas[anchor_g]
+        added: List[int] = []
+        g = capacity
+        while g >= 1:
+            if g not in feas and g <= capacity:
+                pbt = max(float(model(g)), 1e-9)
+                task.strategies[g] = Strategy(
+                    executor=anchor.executor,
+                    apportionment=g,
+                    params=dict(anchor.params or {}),
+                    runtime=pbt * max(task.total_batches, 0),
+                    per_batch_time=pbt,
+                    interpolated=True,
+                )
+                added.append(g)
+                break  # one synthesized size (the largest fitting) is enough
+            if g in feas:
+                break  # a real profile fits — nothing to synthesize
+            g >>= 1
+        return added
+
+    # --------------------------------------------------------------- replan
+    def replan(
+        self,
+        task_list: Sequence,
+        base_topology: SliceTopology,
+        alive_indices: Sequence[int],
+        change,
+        previous_plan: Optional[milp.Plan] = None,
+        time_limit: Optional[float] = None,
+    ) -> ReplanResult:
+        """Rebuild topology + plan for the surviving fleet.
+
+        ``alive_indices`` index into ``base_topology.devices`` (the monitor's
+        view); tasks made unschedulable even after synthesis are evicted
+        under every policy. Emits ``replan`` metrics; the caller emits the
+        ``topology_change`` event (it owns the metrics scope timing).
+        """
+        topo = base_topology.subset(alive_indices)
+        cap = topo.capacity
+
+        synthesized: Dict[str, List[int]] = {}
+        keep: List = []
+        evicted: List[str] = []
+        for t in task_list:
+            if not _runnable(t, cap):
+                added = self._synthesize(t, cap)
+                if added:
+                    synthesized[t.name] = added
+            if _runnable(t, cap):
+                keep.append(t)
+            else:
+                evicted.append(t.name)
+                log.warning(
+                    "replan: task %s cannot run on %d-device mesh — evicting",
+                    t.name, cap,
+                )
+
+        ctx = ReplanContext(
+            topology=topo,
+            previous_plan=previous_plan,
+            previous_makespan=previous_plan.makespan if previous_plan else 0.0,
+            change_kind=getattr(change, "kind", "shrink"),
+            degrade_factor=self.degrade_factor,
+        )
+        keep, policy_evicted = _POLICIES[self.policy](keep, ctx)
+        evicted.extend(t.name for t in policy_evicted)
+
+        if not keep:
+            plan = milp.Plan(assignments={}, makespan=0.0)
+        elif self.policy == "degrade-in-place":
+            plan = self._degrade_in_place(keep, topo, previous_plan)
+        else:
+            plan = milp.solve(keep, topo, time_limit=time_limit, warm=previous_plan)
+
+        migrations = (
+            plan.migrations_from(previous_plan) if previous_plan is not None else {}
+        )
+        metrics.event(
+            "replan",
+            policy=self.policy,
+            capacity=cap,
+            n_tasks=len(keep),
+            evicted=sorted(evicted),
+            synthesized={k: v for k, v in synthesized.items()},
+            makespan_s=plan.makespan,
+            migrated=sorted(n for n, d in migrations.items() if d["moved"]),
+        )
+        return ReplanResult(
+            topology=topo,
+            plan=plan,
+            evicted=evicted,
+            synthesized=synthesized,
+            migrations=migrations,
+        )
+
+    @staticmethod
+    def _degrade_in_place(task_list, topo: SliceTopology, previous: Optional[milp.Plan]) -> milp.Plan:
+        """No-solver recovery: clamp each task's previous size to the new
+        capacity (largest feasible power of two <= min(prev, capacity)) and
+        list-schedule in previous start order via the shared
+        ``DeviceTimeline`` primitive. Falls back to greedy when a task has
+        no previous assignment."""
+        timeline = milp.DeviceTimeline(topo.capacity)
+
+        def prev_start(t):
+            a = previous.assignments.get(t.name) if previous else None
+            return a.start if a is not None else float("inf")
+
+        assignments: Dict[str, milp.Assignment] = {}
+        for t in sorted(task_list, key=prev_start):
+            prev_a = previous.assignments.get(t.name) if previous else None
+            want = min(prev_a.apportionment, topo.capacity) if prev_a else topo.capacity
+            sizes = [g for g in t.feasible_strategies() if g <= want] or [
+                g for g in t.feasible_strategies() if g <= topo.capacity
+            ]
+            size = max(sizes)
+            strat = t.feasible_strategies()[size]
+            best = None  # (start, block)
+            for blk in topo.blocks(size):
+                st = timeline.earliest_free(blk, strat.runtime + 1.0)
+                if best is None or st < best[0]:
+                    best = (st, blk)
+            st, blk = best
+            timeline.occupy(blk, st, st + strat.runtime + 1.0)
+            assignments[t.name] = milp.Assignment(size, blk, st, strat.runtime)
+        makespan = max(
+            (a.start + a.runtime for a in assignments.values()), default=0.0
+        )
+        plan = milp.Plan(assignments=assignments, makespan=makespan)
+        plan.compute_dependencies()
+        return plan

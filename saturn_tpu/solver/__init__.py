@@ -1,0 +1,9 @@
+"""Planning layer: MILP joint allocation/scheduling + greedy fallback.
+
+Public entry point: :func:`solve` — produce a :class:`~saturn_tpu.solver.milp.Plan`
+for a task list over a :class:`~saturn_tpu.core.mesh.SliceTopology`.
+"""
+
+from saturn_tpu.solver.milp import solve
+
+__all__ = ["solve"]

@@ -68,6 +68,32 @@ class Plan:
                         deps[n1].append(n2)
         self.dependencies = deps
 
+    def migrations_from(self, previous: "Plan") -> Dict[str, dict]:
+        """Per-task placement diff against ``previous`` — the elastic
+        replanner's migration report (``resilience/replan.py``). A task
+        "moved" when its sub-mesh size or block changed: its next interval
+        must restore state onto a different mesh (cross-mesh checkpoint
+        migration, ``utils/checkpoint.py::restore_sharded``) instead of
+        reusing live device buffers."""
+        out: Dict[str, dict] = {}
+        for name, a in self.assignments.items():
+            p = previous.assignments.get(name)
+            if p is None:
+                out[name] = {"moved": True, "from": None,
+                             "to": [a.apportionment, a.block.offset]}
+                continue
+            moved = (
+                a.apportionment != p.apportionment
+                or a.block.offset != p.block.offset
+                or a.block.size != p.block.size
+            )
+            out[name] = {
+                "moved": moved,
+                "from": [p.apportionment, p.block.offset],
+                "to": [a.apportionment, a.block.offset],
+            }
+        return out
+
     # Wire format for the multi-host control plane: the coordinator solves,
     # every rank executes the SAME decoded plan (core/distributed.py
     # broadcast_json) — a time-limited HiGHS run is not deterministic

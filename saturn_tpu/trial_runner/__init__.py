@@ -1,0 +1,9 @@
+"""Profiling layer: the trial sweep that populates task strategies.
+
+Public entry point: :func:`search` — profile every (task, technique, size)
+cell and attach the resulting strategies to each task.
+"""
+
+from saturn_tpu.trial_runner.evaluator import search
+
+__all__ = ["search"]

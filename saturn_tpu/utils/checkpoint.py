@@ -236,6 +236,33 @@ def restore(path: str, template: Any) -> Any:
     return jax.tree_util.tree_map_with_path(replace, template)
 
 
+def restore_sharded(path: str, template: Any, sharding: Any) -> Any:
+    """``restore`` + place every leaf on devices under ``sharding``.
+
+    This is the cross-mesh migration primitive: a checkpoint written on one
+    mesh shape restores onto a *different* one (half the devices after a
+    slice preemption, twice after a grow), because the npz holds full host
+    arrays keyed by tree path — nothing about the old mesh survives in the
+    file. ``sharding`` is one of:
+
+    - a single ``jax.sharding.Sharding`` applied to every leaf (the common
+      fully-replicated / uniform case),
+    - a pytree of shardings matching ``template``'s structure,
+    - a callable ``(tree_path, host_leaf) -> Sharding`` for per-leaf rules.
+    """
+    host = restore(path, template)
+    if isinstance(sharding, jax.sharding.Sharding):
+        # isinstance check FIRST: Sharding subclasses may be callable.
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, sharding), host
+        )
+    if callable(sharding):
+        return jax.tree_util.tree_map_with_path(
+            lambda p, leaf: jax.device_put(leaf, sharding(p, leaf)), host
+        )
+    return jax.tree_util.tree_map(jax.device_put, host, sharding)
+
+
 def exists(path: str) -> bool:
     """True if a checkpoint exists (joining any in-flight async write first,
     so a just-scheduled save counts).

@@ -66,6 +66,25 @@ def event(kind: str, **fields) -> None:
         w.event(kind, **fields)
 
 
+def read_events(path: str, kind: Optional[str] = None) -> list:
+    """Read a JSONL metrics file back as dicts, optionally filtered by
+    ``kind`` — the test/analysis counterpart to :func:`event`. Lines that
+    fail to parse (a crashed writer's torn tail) are skipped."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
 @contextlib.contextmanager
 def scoped(path: Optional[str]):
     """Route events to ``path`` for the enclosed region, then restore the

@@ -1,0 +1,547 @@
+"""Elastic resilience: fault injection, health monitoring, replan, migration.
+
+Everything here runs hardware-free on the 8 virtual CPU devices from
+conftest. The acceptance test at the bottom is the ISSUE's scenario: a
+seeded run that loses a 4-device slice mid-interval must still complete
+every task, with the replanner shrinking the plan and migrated tasks
+resuming from their checkpoints on the surviving mesh.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.core.technique import BaseTechnique
+from saturn_tpu.executor import orchestrate
+from saturn_tpu.resilience import (
+    ElasticReplanner,
+    FaultEvent,
+    FaultInjector,
+    FaultKind,
+    FleetHealthMonitor,
+    PreemptedError,
+    seeded_schedule,
+)
+from saturn_tpu.solver import milp
+from saturn_tpu.utils.metrics import read_events
+
+pytestmark = pytest.mark.resilience
+
+
+class FakeDev:
+    pass
+
+
+def topo(n=8):
+    return SliceTopology([FakeDev() for _ in range(n)])
+
+
+class RecordingTech(BaseTechnique):
+    """Sleeps per batch; records (task, block-size, batches) calls."""
+
+    name = "fake"
+
+    def __init__(self, per_batch=0.001):
+        self.per_batch = per_batch
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        time.sleep(self.per_batch * (override_batch_count or 1))
+        with self.lock:
+            self.calls.append((task.name, len(devices), override_batch_count))
+
+    def search(self, task, devices, tid):
+        return {}, self.per_batch
+
+
+class FakeTask:
+    def __init__(self, name, total_batches, sizes, tech, pbt=0.001, hints=None):
+        self.name = name
+        self.total_batches = total_batches
+        self.current_batch = 0
+        self.epoch_length = 1000
+        self.hints = dict(hints or {})
+        self.strategies = {
+            g: Strategy(tech, g, {}, pbt * total_batches, pbt) for g in sizes
+        }
+        self.selected_strategy = None
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+    def select_strategy(self, g):
+        self.selected_strategy = self.strategies[g]
+
+    def reconfigure(self, n):
+        self.current_batch = (self.current_batch + n) % self.epoch_length
+
+
+class TestFaultInjector:
+    def test_env_parsing(self, monkeypatch):
+        monkeypatch.setenv(
+            "SATURN_TPU_FAULTS",
+            "1+0.05:slice_preemption:4-7;2:trial_crash:jobA;3:straggler:0,2@4.5",
+        )
+        fi = FaultInjector.from_env()
+        assert [e.kind for e in fi.schedule] == [
+            FaultKind.SLICE_PREEMPTION, FaultKind.TRIAL_CRASH, FaultKind.STRAGGLER,
+        ]
+        pre, crash, strag = fi.schedule
+        assert pre.devices == (4, 5, 6, 7) and pre.after_s == 0.05 and pre.mid_interval
+        assert crash.task == "jobA" and not crash.mid_interval
+        assert strag.devices == (0, 2) and strag.slowdown == 4.5
+
+    def test_env_unset_and_garbage(self, monkeypatch):
+        monkeypatch.delenv("SATURN_TPU_FAULTS", raising=False)
+        assert FaultInjector.from_env() is None
+        monkeypatch.setenv("SATURN_TPU_FAULTS", "nonsense")
+        with pytest.raises(ValueError, match="SATURN_TPU_FAULTS"):
+            FaultInjector.from_env()
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultEvent(0, "meteor_strike")
+
+    def test_seeded_schedule_deterministic(self):
+        a = seeded_schedule(42, 20, 8)
+        b = seeded_schedule(42, 20, 8)
+        assert a == b
+        assert a != seeded_schedule(43, 20, 8)
+        for e in a:
+            if e.kind == FaultKind.SLICE_PREEMPTION:
+                size = len(e.devices)
+                assert size & (size - 1) == 0  # power-of-two block
+                assert e.devices[0] % size == 0  # aligned
+
+    def test_crash_fires_exactly_once(self):
+        fi = FaultInjector(schedule=[FaultEvent(1, FaultKind.TRIAL_CRASH, task="a")])
+        assert not fi.crashes("a", 0)  # wrong interval
+        assert not fi.crashes("b", 1)  # wrong task
+        assert fi.crashes("a", 1)
+        assert not fi.crashes("a", 1)  # transient: consumed
+
+    def test_apply_due_drives_monitor(self):
+        fi = FaultInjector(schedule=[
+            FaultEvent(0, FaultKind.DEVICE_LOSS, devices=(3,)),
+            FaultEvent(0, FaultKind.SLICE_PREEMPTION, devices=(4, 5), after_s=0.1),
+            FaultEvent(1, FaultKind.DEVICE_RETURN, devices=(3,)),
+        ])
+        mon = FleetHealthMonitor(8)
+        applied = fi.apply_due(0, mon)
+        assert [e.kind for e in applied] == [FaultKind.DEVICE_LOSS]
+        assert mon.alive_indices() == [0, 1, 2, 4, 5, 6, 7]
+        # the mid-interval event belongs to the watchdog, not the poll
+        assert [e.devices for e in fi.due(0, mid_interval=True)] == [(4, 5)]
+        fi.apply_due(1, mon)
+        assert mon.alive_indices() == list(range(8))
+
+    def test_watchdog_marks_and_aborts(self):
+        fi = FaultInjector(schedule=[
+            FaultEvent(0, FaultKind.SLICE_PREEMPTION, devices=(0, 1), after_s=0.02),
+        ])
+        mon = FleetHealthMonitor(4)
+        abort = threading.Event()
+        timers = fi.arm_watchdog(0, mon, abort)
+        assert len(timers) == 1
+        assert abort.wait(timeout=2.0)
+        assert mon.alive_indices() == [2, 3]
+        for t in timers:
+            t.cancel()
+
+
+class TestHealthMonitor:
+    def test_poll_aggregation_shrink_wins(self):
+        mon = FleetHealthMonitor(8)
+        mon.mark_lost([4, 5], cause="slice_preemption")
+        mon.mark_restored([4])  # same window: net loss of just 5
+        mon.mark_lost([6])
+        c = mon.poll()
+        assert c.kind == "shrink" and c.lost == (5, 6)
+        assert mon.poll() is None  # consumed
+
+    def test_grow_after_return(self):
+        mon = FleetHealthMonitor(8)
+        mon.mark_lost([7])
+        mon.poll()
+        mon.mark_restored([7])
+        c = mon.poll()
+        assert c.kind == "grow" and c.gained == (7,)
+        assert mon.alive_indices() == list(range(8))
+
+    def test_straggler_detection_via_latency(self):
+        mon = FleetHealthMonitor(8, straggler_factor=3.0)
+        mon.mark_straggler([2], slowdown=5.0)
+        for _ in range(3):  # injected slowdown inflates device 2's EWMA
+            mon.note_step(list(range(8)), per_batch_s=0.01)
+        assert mon.stragglers() == [2]
+        c = mon.poll()
+        assert c.kind == "degrade" and c.stragglers == (2,)
+
+    def test_indices_and_any_lost(self):
+        devs = [FakeDev() for _ in range(4)]
+        mon = FleetHealthMonitor(4)
+        assert mon.indices_of(devs) == []  # unbound monitor stays inert
+        mon.bind_devices(devs)
+        assert mon.indices_of([devs[2], devs[0]]) == [2, 0]
+        mon.mark_lost([2])
+        assert mon.any_lost([0, 2]) and not mon.any_lost([0, 1])
+        assert mon.any_lost([99])  # unknown device counts as dead
+
+    def test_restored_chip_forgets_history(self):
+        mon = FleetHealthMonitor(2)
+        mon.mark_straggler([0], slowdown=9.0)
+        mon.note_step([0, 1], 0.01)
+        mon.mark_lost([0])
+        mon.mark_restored([0])
+        assert mon._devices[0].latency_ewma is None
+        assert mon._devices[0].slowdown == 1.0
+
+
+class TestMeshSubset:
+    def test_subset_preserves_devices_and_capacity(self):
+        t = topo(8)
+        sub = t.subset([0, 1, 2, 3])
+        assert sub.capacity == 4
+        assert sub.devices == t.devices[:4]  # same objects: id-map survives
+
+    def test_subset_non_pow2_survivors(self):
+        sub = topo(8).subset([0, 1, 2, 3, 4, 6])
+        assert len(sub.devices) == 6 and sub.capacity == 4
+
+    def test_subset_rejects_bad_indices(self):
+        with pytest.raises(ValueError):
+            topo(8).subset([])
+        with pytest.raises(ValueError):
+            topo(8).subset([0, 8])
+
+
+class TestReplanner:
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="unknown recovery policy"):
+            ElasticReplanner(policy="wing-it")
+
+    def _change(self, mon, lost):
+        mon.mark_lost(lost, cause="slice_preemption")
+        return mon.poll()
+
+    def test_shrink_synthesizes_interpolated_strategy(self):
+        tech = RecordingTech()
+        t8 = FakeTask("only8", 50, [8], tech, pbt=0.01)
+        t48 = FakeTask("both", 50, [4, 8], tech, pbt=0.01)
+        base = topo(8)
+        mon = FleetHealthMonitor.for_topology(base)
+        prev = milp.solve([t8, t48], base)
+        change = self._change(mon, [4, 5, 6, 7])
+        res = ElasticReplanner().replan(
+            [t8, t48], base, mon.alive_indices(), change, previous_plan=prev
+        )
+        assert res.topology.capacity == 4 and res.evicted == []
+        assert res.synthesized == {"only8": [4]}
+        assert t8.strategies[4].interpolated
+        assert res.plan.assignments["only8"].apportionment <= 4
+        # both tasks previously on >=4-device blocks of an 8-ring: moved
+        assert any(d["moved"] for d in res.migrations.values())
+
+    def test_unschedulable_task_evicted(self):
+        tech = RecordingTech()
+        # per_batch_time 0 -> no measured points -> synthesis impossible
+        dead = FakeTask("dead", 10, [8], tech, pbt=0.01)
+        dead.strategies[8].per_batch_time = 0.0
+        ok = FakeTask("ok", 10, [4], tech, pbt=0.01)
+        base = topo(8)
+        mon = FleetHealthMonitor.for_topology(base)
+        change = self._change(mon, [4, 5, 6, 7])
+        res = ElasticReplanner().replan([dead, ok], base, mon.alive_indices(), change)
+        assert res.evicted == ["dead"]
+        assert set(res.plan.assignments) == {"ok"}
+
+    def test_evict_lowest_priority_policy(self):
+        tech = RecordingTech()
+        hi = FakeTask("hi", 100, [2], tech, pbt=0.05, hints={"priority": 10})
+        lo = FakeTask("lo", 100, [2], tech, pbt=0.05, hints={"priority": -5})
+        base = topo(8)
+        prev = milp.solve([hi, lo], base)
+        mon = FleetHealthMonitor.for_topology(base)
+        change = self._change(mon, [2, 3, 4, 5, 6, 7])
+        # 2 surviving chips serialize both tasks: makespan doubles, which a
+        # degrade_factor of 1.2 refuses — the low-priority task goes
+        res = ElasticReplanner(
+            policy="evict-lowest-priority", degrade_factor=1.2
+        ).replan([hi, lo], base, mon.alive_indices(), change, previous_plan=prev)
+        assert res.evicted == ["lo"]
+        assert set(res.plan.assignments) == {"hi"}
+
+    def test_degrade_in_place_skips_solver(self, monkeypatch):
+        tech = RecordingTech()
+        a = FakeTask("a", 20, [2, 4], tech, pbt=0.01)
+        b = FakeTask("b", 20, [2, 4], tech, pbt=0.01)
+        base = topo(8)
+        prev = milp.solve([a, b], base)
+        mon = FleetHealthMonitor.for_topology(base)
+        change = self._change(mon, [4, 5, 6, 7])
+
+        def boom(*a, **kw):  # degrade-in-place must never re-solve
+            raise AssertionError("solver invoked under degrade-in-place")
+
+        monkeypatch.setattr(milp, "solve", boom)
+        res = ElasticReplanner(policy="degrade-in-place").replan(
+            [a, b], base, mon.alive_indices(), change, previous_plan=prev
+        )
+        assert set(res.plan.assignments) == {"a", "b"}
+        for asg in res.plan.assignments.values():
+            assert asg.apportionment <= 4
+            assert asg.block.end <= 4  # on the surviving mesh
+
+
+class CheckpointingTech(BaseTechnique):
+    """Batch-granular technique with real resume semantics.
+
+    Tracks progress in a per-task npz via ``utils/checkpoint`` (the same
+    module real techniques use). Mirrors real device behavior under
+    preemption: if the block lost a chip mid-run, the in-flight step raises
+    ``PreemptedError`` *without* checkpointing — the work is gone, exactly
+    like an XLA abort — so resumed step counts stay exact.
+    """
+
+    name = "ckpt-fake"
+
+    def __init__(self, ckpt_dir, monitor, per_batch=0.001):
+        self.ckpt_dir = ckpt_dir
+        self.monitor = monitor
+        self.per_batch = per_batch
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def _path(self, task):
+        return f"{self.ckpt_dir}/{task.name}.npz"
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        path = self._path(task)
+        step = (
+            int(ckpt.restore(path, {"step": np.zeros((), np.int64)})["step"])
+            if ckpt.exists(path)
+            else 0
+        )
+        with self.lock:
+            self.calls.append((task.name, len(devices), step))
+        didx = self.monitor.indices_of(devices)
+        for _ in range(override_batch_count or 1):
+            time.sleep(self.per_batch)
+            if didx and self.monitor.any_lost(didx):
+                raise PreemptedError(
+                    f"simulated XLA abort for {task.name}: block lost a chip"
+                )
+            step += 1
+        ckpt.save(path, {"step": np.asarray(step, np.int64)})
+
+    def search(self, task, devices, tid):
+        return {}, self.per_batch
+
+
+class TestElasticOrchestration:
+    """The ISSUE's acceptance scenario plus the retry/crash interactions."""
+
+    def test_preemption_mid_interval_completes_all_tasks(self, tmp_path):
+        base = topo(8)
+        mon = FleetHealthMonitor.for_topology(base)
+        tech = CheckpointingTech(str(tmp_path), mon, per_batch=0.01)
+        tasks = [
+            FakeTask(f"job{i}", 50, [2, 4], tech, pbt=0.01) for i in range(3)
+        ]
+        # interval 0 checkpoints ~25 batches/task; the preemption lands in
+        # interval 1 so the post-shrink resume is from a REAL checkpoint
+        fi = FaultInjector(schedule=[
+            FaultEvent(1, FaultKind.SLICE_PREEMPTION, devices=(4, 5, 6, 7),
+                       after_s=0.08),
+        ])
+        mpath = str(tmp_path / "m.jsonl")
+        out = orchestrate(
+            tasks, interval=0.25, topology=base, fault_injector=fi,
+            health_monitor=mon, failure_policy="retry", metrics_path=mpath,
+        )
+        assert sorted(out["completed"]) == ["job0", "job1", "job2"]
+        assert out["failed"] == {}
+        # exact progress: every task ran its 50 batches exactly once
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        for t in tasks:
+            saved = ckpt.restore(
+                f"{tmp_path}/{t.name}.npz", {"step": np.zeros((), np.int64)}
+            )
+            assert int(saved["step"]) == 50
+        kinds = [e["kind"] for e in read_events(mpath)]
+        assert "topology_change" in kinds
+        assert "replan" in kinds
+        assert "migration" in kinds
+        assert "recovery" in kinds
+        assert "task_preempted" in kinds
+        assert "task_failed" not in kinds  # preemption is not failure
+        change = read_events(mpath, kind="topology_change")[0]
+        assert change["change"] == "shrink" and change["lost"] == [4, 5, 6, 7]
+        # post-shrink work ran on the surviving half: blocks of <= 4 chips
+        resumed = [c for c in self.last_calls(tech) if c[2] > 0]
+        assert resumed and all(size <= 4 for _, size, _ in resumed)
+
+    @staticmethod
+    def last_calls(tech):
+        with tech.lock:
+            return list(tech.calls)
+
+    def test_preemption_does_not_consume_retry_budget(self, tmp_path):
+        """A task preempted twice still has its full retry budget."""
+        base = topo(8)
+        mon = FleetHealthMonitor.for_topology(base)
+        tech = CheckpointingTech(str(tmp_path), mon, per_batch=0.01)
+        tasks = [FakeTask("solo", 40, [2, 4], tech, pbt=0.01)]
+        fi = FaultInjector(schedule=[
+            FaultEvent(0, FaultKind.DEVICE_LOSS, devices=(4,), after_s=0.1),
+            FaultEvent(1, FaultKind.DEVICE_LOSS, devices=(5,), after_s=0.1),
+        ])
+        mpath = str(tmp_path / "m.jsonl")
+        out = orchestrate(
+            tasks, interval=0.4, topology=base, fault_injector=fi,
+            health_monitor=mon, failure_policy="retry", max_task_retries=0,
+            metrics_path=mpath,
+        )
+        assert out["completed"] == ["solo"] and out["failed"] == {}
+        events = read_events(mpath)
+        assert not [e for e in events if e["kind"] == "task_retry"]
+
+    def test_injected_trial_crash_retries(self, tmp_path):
+        """A scheduled transient crash flows through the ordinary retry
+        path (counts against the budget — unlike preemption)."""
+        tech = RecordingTech(per_batch=0.005)
+        tasks = [FakeTask("crashy", 20, [4, 8], tech, pbt=0.005)]
+        fi = FaultInjector(schedule=[
+            FaultEvent(0, FaultKind.TRIAL_CRASH, task="crashy"),
+        ])
+        mpath = str(tmp_path / "m.jsonl")
+        out = orchestrate(
+            tasks, interval=0.5, topology=topo(8), fault_injector=fi,
+            failure_policy="retry", metrics_path=mpath,
+        )
+        assert out["completed"] == ["crashy"] and out["failed"] == {}
+        retries = read_events(mpath, kind="task_retry")
+        assert len(retries) == 1 and "injected transient" in retries[0]["error"]
+
+    def test_env_var_schedule_drives_run(self, tmp_path, monkeypatch):
+        """SATURN_TPU_FAULTS alone (no injector argument) goes elastic."""
+        monkeypatch.setenv("SATURN_TPU_FAULTS", "0+0.05:slice_preemption:4-7")
+        tech = RecordingTech(per_batch=0.01)
+        tasks = [FakeTask(f"e{i}", 30, [2, 4], tech, pbt=0.01) for i in range(2)]
+        mpath = str(tmp_path / "m.jsonl")
+        out = orchestrate(
+            tasks, interval=0.15, topology=topo(8),
+            failure_policy="retry", metrics_path=mpath,
+        )
+        assert sorted(out["completed"]) == ["e0", "e1"]
+        assert read_events(mpath, kind="topology_change")
+
+    def test_seeded_chaos_run_completes(self, tmp_path):
+        """Fast seeded smoke: a random-but-reproducible fault schedule must
+        never lose work (preempted tasks requeue, crashes retry)."""
+        base = topo(8)
+        mon = FleetHealthMonitor.for_topology(base)
+        tech = CheckpointingTech(str(tmp_path), mon, per_batch=0.005)
+        tasks = [FakeTask(f"s{i}", 30, [1, 2, 4], tech, pbt=0.005)
+                 for i in range(2)]
+        fi = FaultInjector(
+            schedule=seeded_schedule(11, n_intervals=4, n_devices=8,
+                                     p_preempt=0.6, p_crash=0.3)
+        )
+        out = orchestrate(
+            tasks, interval=0.3, topology=base, fault_injector=fi,
+            health_monitor=mon, failure_policy="retry", max_task_retries=3,
+        )
+        assert sorted(out["completed"]) == ["s0", "s1"]
+        assert out["failed"] == {}
+
+    def test_multihost_refuses_elastic(self, monkeypatch):
+        from saturn_tpu.core import distributed
+
+        monkeypatch.setattr(distributed, "is_multihost", lambda: True)
+        tech = RecordingTech()
+        tasks = [FakeTask("a", 5, [4], tech)]
+        with pytest.raises(ValueError, match="single-host only"):
+            orchestrate(
+                tasks, topology=topo(8),
+                health_monitor=FleetHealthMonitor(8),
+            )
+
+
+class CountingFlakyTech(BaseTechnique):
+    """Fails the first ``fail_times`` execute calls per task, then succeeds;
+    records every attempt so retry accounting can be asserted exactly."""
+
+    name = "counting-flaky"
+
+    def __init__(self, fail_times, per_batch=0.002):
+        self.fail_times = fail_times
+        self.per_batch = per_batch
+        self.attempts = {}
+        self.lock = threading.Lock()
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        with self.lock:
+            self.attempts[task.name] = self.attempts.get(task.name, 0) + 1
+            n = self.attempts[task.name]
+        if n <= self.fail_times:
+            raise RuntimeError(f"flaky failure {n} for {task.name}")
+        time.sleep(self.per_batch * (override_batch_count or 1))
+
+    def search(self, task, devices, tid):
+        return {}, self.per_batch
+
+
+class TestRetryAccounting:
+    """failure_policy='retry' bookkeeping, exact to the attempt."""
+
+    def test_success_on_final_allowed_attempt(self, tmp_path):
+        """fail, fail, succeed with max_task_retries=2: completed, not
+        failed — and both retries are visible in the metrics stream."""
+        tech = CountingFlakyTech(fail_times=2)
+        t = FakeTask("phoenix", 10, [8], tech, pbt=0.002)
+        mpath = str(tmp_path / "m.jsonl")
+        out = orchestrate(
+            [t], interval=0.5, topology=topo(8), failure_policy="retry",
+            max_task_retries=2, metrics_path=mpath,
+        )
+        assert out["completed"] == ["phoenix"]
+        assert out["failed"] == {}
+        assert tech.attempts["phoenix"] == 3  # 1 + exactly max_task_retries
+        events = read_events(mpath)
+        assert sum(e["kind"] == "task_retry" for e in events) == 2
+        assert not [e for e in events if e["kind"] == "task_failed"]
+
+    def test_budget_honored_exactly(self, tmp_path):
+        """A task failing one past the budget is evicted after exactly
+        1 + max_task_retries attempts — no extra interval is spent."""
+        tech = CountingFlakyTech(fail_times=99)
+        t = FakeTask("doomed", 10, [8], tech, pbt=0.002)
+        mpath = str(tmp_path / "m.jsonl")
+        out = orchestrate(
+            [t], interval=0.5, topology=topo(8), failure_policy="retry",
+            max_task_retries=2, metrics_path=mpath,
+        )
+        assert out["completed"] == []
+        assert "doomed" in out["failed"]
+        assert tech.attempts["doomed"] == 3
+        events = read_events(mpath)
+        assert sum(e["kind"] == "task_retry" for e in events) == 2
+        assert sum(e["kind"] == "task_failed" for e in events) == 1
+
+    def test_zero_retries_is_drop(self, tmp_path):
+        tech = CountingFlakyTech(fail_times=99)
+        t = FakeTask("oneshot", 10, [8], tech, pbt=0.002)
+        out = orchestrate(
+            [t], interval=0.5, topology=topo(8), failure_policy="retry",
+            max_task_retries=0,
+        )
+        assert "oneshot" in out["failed"] and tech.attempts["oneshot"] == 1
