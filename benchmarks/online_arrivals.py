@@ -1,0 +1,158 @@
+"""Online service under a Poisson arrival trace: admission latency + makespan.
+
+Submits a seeded Poisson stream of jobs (exponential inter-arrival times,
+mixed priorities) to a running ``SaturnService`` on the 8 virtual CPU
+devices, twice against the same persistent profile cache directory:
+
+- **cold**: empty cache — every arrival pays its profiling sweep (the fake
+  technique sleeps per trial to stand in for XLA compile time),
+- **warm**: same task fingerprints again — every arrival resolves from the
+  cache with zero trials, so admission latency collapses to the lookup.
+
+Prints ONE JSON line like ``bench.py``:
+
+    {"metric": "online_admission_latency", "cold_s": ..., "warm_s": ...,
+     "speedup": ..., "makespan_cold_s": ..., "makespan_warm_s": ...,
+     "warm_trials": 0, "n_jobs": ...}
+
+Run: ``python benchmarks/online_arrivals.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from saturn_tpu import library as lib
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.technique import BaseTechnique
+from saturn_tpu.service import SaturnService, ServiceClient
+from saturn_tpu.utils.metrics import read_events
+
+N_JOBS = 6
+ARRIVAL_RATE_HZ = 5.0     # mean inter-arrival 200 ms
+TRIAL_COST_S = 0.02       # stand-in for compile time per profiling trial
+PER_BATCH_S = 0.004
+SEED = 7
+
+
+class FakeDev:
+    pass
+
+
+class BenchTech(BaseTechnique):
+    """Profiles with a fixed sleep (the 'compile'), executes by sleeping."""
+
+    name = "bench-online"
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        time.sleep(PER_BATCH_S * (override_batch_count or 1))
+
+    def search(self, task, devices, tid):
+        time.sleep(TRIAL_COST_S)
+        return {}, PER_BATCH_S
+
+
+class FakeTask:
+    """Duck-typed Task: profilable (no pre-filled strategies), cacheable
+    (stable degraded fingerprint + a distinguishing ``family`` hint)."""
+
+    def __init__(self, name, family, total_batches=40):
+        self.name = name
+        self.total_batches = total_batches
+        self.current_batch = 0
+        self.epoch_length = 1000
+        self.hints = {"family": family}
+        self.chip_range = None
+        self.strategies = {}
+        self.selected_strategy = None
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+    def select_strategy(self, g):
+        self.selected_strategy = self.strategies[g]
+
+    def reconfigure(self, n):
+        self.current_batch = (self.current_batch + n) % self.epoch_length
+
+
+def run_phase(phase: str, cache_dir: str, topo: SliceTopology) -> dict:
+    rng = random.Random(SEED)  # same trace both phases
+    mpath = tempfile.mktemp(suffix=".jsonl")
+    svc = SaturnService(
+        topology=topo, interval=0.2, metrics_path=mpath,
+        technique_names=["bench-online"], profile_cache=cache_dir,
+        poll_s=0.02,
+    ).start()
+    client = ServiceClient(svc)
+    try:
+        t0 = time.monotonic()
+        ids = []
+        for i in range(N_JOBS):
+            time.sleep(rng.expovariate(ARRIVAL_RATE_HZ))
+            ids.append(client.submit(
+                FakeTask(f"{phase}-job{i}", family=i),
+                priority=float(rng.randint(0, 2)),
+            ))
+        for jid in ids:
+            out = client.wait(jid, timeout=120)
+            if out["state"] != "DONE":
+                raise SystemExit(f"benchmark job did not finish: {out}")
+        makespan = time.monotonic() - t0
+        svc.stop(timeout=30)
+        admits = [e for e in read_events(mpath, kind="job_admitted")
+                  if e["decision"] == "admit"]
+        if len(admits) != N_JOBS:
+            raise SystemExit(f"expected {N_JOBS} admissions, got {admits}")
+        return {
+            "mean_admission_s": sum(e["latency_s"] for e in admits) / len(admits),
+            "trials": sum(e["trials_run"] for e in admits),
+            "makespan_s": makespan,
+        }
+    finally:
+        if os.path.exists(mpath):
+            os.unlink(mpath)
+
+
+def main() -> None:
+    lib.register("bench-online", BenchTech)
+    topo = SliceTopology([FakeDev() for _ in range(8)])
+    cache_dir = tempfile.mkdtemp(prefix="saturn_bench_pcache_")
+    try:
+        cold = run_phase("cold", cache_dir, topo)
+        warm = run_phase("warm", cache_dir, topo)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "online_admission_latency",
+        "cold_s": round(cold["mean_admission_s"], 6),
+        "warm_s": round(warm["mean_admission_s"], 6),
+        "speedup": round(
+            cold["mean_admission_s"] / max(warm["mean_admission_s"], 1e-9), 2
+        ),
+        "cold_trials": cold["trials"],
+        "warm_trials": warm["trials"],
+        "makespan_cold_s": round(cold["makespan_s"], 6),
+        "makespan_warm_s": round(warm["makespan_s"], 6),
+        "n_jobs": N_JOBS,
+        "unit": "s",
+    }))
+
+
+if __name__ == "__main__":
+    main()

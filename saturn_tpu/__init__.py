@@ -28,6 +28,7 @@ __all__ = [
     "library",
     "search",
     "orchestrate",
+    "serve",
 ]
 
 
@@ -43,11 +44,56 @@ def search(tasks, technique_names=None, log=False, topology=None, **kw):
     )
 
 
-def orchestrate(task_list, log=False, interval=1000, topology=None, **kw):
+def orchestrate(
+    task_list,
+    log=False,
+    interval=1000.0,
+    topology=None,
+    threshold=0.0,
+    solver_time_limit=None,
+    failure_policy="raise",
+    max_task_retries=1,
+    metrics_path=None,
+    trace_dir=None,
+    fault_injector=None,
+    health_monitor=None,
+    recovery_policy="pause-resolve-resume",
+    replan_degrade_factor=2.0,
+):
     """Solve the SPASE problem and run the batch to completion.
 
-    Reference: ``saturn/orchestrator.py:32``.
+    Reference: ``saturn/orchestrator.py:32``. Mirrors
+    ``executor.orchestrator.orchestrate`` exactly (parameter names, order
+    and defaults — a signature-parity test enforces it) so callers get
+    introspectable keywords instead of an opaque ``**kw`` passthrough.
     """
     from saturn_tpu.executor.orchestrator import orchestrate as _orch
 
-    return _orch(task_list, log=log, interval=interval, topology=topology, **kw)
+    return _orch(
+        task_list,
+        log=log,
+        interval=interval,
+        topology=topology,
+        threshold=threshold,
+        solver_time_limit=solver_time_limit,
+        failure_policy=failure_policy,
+        max_task_retries=max_task_retries,
+        metrics_path=metrics_path,
+        trace_dir=trace_dir,
+        fault_injector=fault_injector,
+        health_monitor=health_monitor,
+        recovery_policy=recovery_policy,
+        replan_degrade_factor=replan_degrade_factor,
+    )
+
+
+def serve(topology=None, **kw):
+    """Start an online job service (``saturn_tpu.service.SaturnService``)
+    and return (service, client): the always-on counterpart to the batch
+    ``orchestrate`` — jobs submit over time, admission profiles them through
+    the profile cache, and each interval boundary re-solves incrementally.
+    """
+    from saturn_tpu.service import SaturnService, ServiceClient
+
+    svc = SaturnService(topology=topology, **kw).start()
+    return svc, ServiceClient(svc)

@@ -67,6 +67,20 @@ def forecast(
     return relevant, batches, completed
 
 
+def rollback_forecast(task, n_batches: int) -> None:
+    """Undo :func:`forecast`'s optimistic accounting for a task whose
+    interval never ran to durable completion (preemption, retryable failure):
+    the pre-deducted batches go back on the budget and every feasible
+    strategy's remaining runtime is re-derived from its per-batch profile —
+    the checkpoint is the ground truth the next attempt resumes from.
+    Shared by the batch orchestrator's retry/preemption paths and the online
+    service's requeue path."""
+    task.total_batches += n_batches
+    for s in task.strategies.values():
+        if s.feasible:
+            s.runtime = s.per_batch_time * task.total_batches
+
+
 def _check_disjoint(run_tasks, plan) -> None:
     """Device-race + deadlock guard for the gang launch. The MILP's plans
     satisfy both properties by construction; a hand-built or corrupted plan
@@ -137,6 +151,7 @@ def execute(
     health=None,
     faults=None,
     interval_index: int = 0,
+    on_task_start=None,
 ) -> Dict[str, BaseException]:
     """Gang-execute one interval (reference ``executor.py:88-129``).
 
@@ -162,6 +177,11 @@ def execute(
     transient crashes and arms the mid-interval watchdog timers. Elastic
     hooks are single-host only (the multi-host path ignores them; the
     orchestrator refuses the combination up front).
+
+    ``on_task_start`` (single-host only): callback invoked with the task name
+    from its launcher thread once dependencies and the preemption gate have
+    cleared, immediately before the technique runs. The online job service
+    uses it to mark jobs RUNNING at the true launch instant.
     """
     from saturn_tpu.core import distributed
 
@@ -204,6 +224,8 @@ def execute(
                     f"(block [{a.block.offset}:{a.block.end}])"
                 )
             task.select_strategy(a.apportionment)
+            if on_task_start is not None:
+                on_task_start(task.name)
             tech = task.selected_strategy.executor
             n = batches[task.name]
             logger.info(

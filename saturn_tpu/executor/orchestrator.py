@@ -188,6 +188,22 @@ def _persist_realized(task) -> None:
                      exc_info=True)
 
 
+def fold_realized_feedback(run_tasks) -> dict:
+    """Fold each executed task's realized per-batch time into its strategy
+    (EWMA via ``Task.apply_realized_feedback``) and persist the measured
+    number to the profile cache. Returns ``{name: (old, new)}`` for the tasks
+    that produced an update. Call only while no solver thread is reading
+    strategy state. Shared by the interval loop and the online job service."""
+    updates = {}
+    for t in run_tasks:
+        apply_fb = getattr(t, "apply_realized_feedback", None)
+        upd = apply_fb() if apply_fb is not None else None
+        if upd is not None:
+            updates[t.name] = upd
+            _persist_realized(t)
+    return updates
+
+
 def _handle_topology_change(
     task_list, base_topo, health, replanner, change, plan, tlimit,
     all_failed,
@@ -371,13 +387,7 @@ def _orchestrate_loop(
                 # is reading strategy state; the NEXT re-solve and forecast
                 # consume the corrected numbers. The reference only logged
                 # this error (``executor.py:126-129``).
-                local_updates = {}
-                for t in run_tasks:
-                    apply_fb = getattr(t, "apply_realized_feedback", None)
-                    upd = apply_fb() if apply_fb is not None else None
-                    if upd is not None:
-                        local_updates[t.name] = upd
-                        _persist_realized(t)
+                local_updates = fold_realized_feedback(run_tasks)
                 all_updates = local_updates
                 if multihost and run_tasks:
                     # All ranks must forecast from identical numbers. Each
@@ -429,11 +439,7 @@ def _orchestrate_loop(
                         release = getattr(t, "release_live_state", None)
                         if release is not None:
                             release()  # device state died with the chips
-                        n = batches.get(name, 0)
-                        t.total_batches += n
-                        for s in t.strategies.values():
-                            if s.feasible:
-                                s.runtime = s.per_batch_time * t.total_batches
+                        engine.rollback_forecast(t, batches.get(name, 0))
                         metrics.event("task_preempted", task=name,
                                       error=repr(err))
                         logger.warning(
@@ -463,11 +469,7 @@ def _orchestrate_loop(
                             # Roll back forecast's optimistic accounting: the
                             # batches it pre-deducted never ran (the checkpoint
                             # is the ground truth the retry resumes from).
-                            n = batches.get(name, 0)
-                            t.total_batches += n
-                            for s in t.strategies.values():
-                                if s.feasible:
-                                    s.runtime = s.per_batch_time * t.total_batches
+                            engine.rollback_forecast(t, batches.get(name, 0))
                             retried.append(t)
                             metrics.event("task_retry", task=name,
                                           attempt=retries[name], error=repr(err))

@@ -82,6 +82,21 @@ def register_policy(name: str, fn: Callable) -> None:
     _POLICIES[name] = fn
 
 
+def get_policy(name: str) -> Callable:
+    """Look up a recovery policy ``(tasks, ctx) -> (keep, evict)`` by name.
+
+    Public accessor for callers outside the replanner — the online job
+    service reuses ``evict-lowest-priority`` to shed load under admission
+    pressure (deadline slack exhausted) without a topology change."""
+    try:
+        return _POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown recovery policy {name!r}; built-ins: {RECOVERY_POLICIES}, "
+            f"registered: {sorted(_POLICIES)}"
+        ) from None
+
+
 def _priority(task) -> float:
     return float(getattr(task, "hints", {}).get("priority", 0.0))
 

@@ -1,0 +1,45 @@
+"""Online job service: the always-on face of the batch orchestrator.
+
+The reference (and our ``orchestrate``) solves SPASE for a *fixed batch* of
+tasks — a closed world. This package turns the same machinery (interval loop,
+persistent profile cache, ElasticReplanner) into a long-running scheduler
+that accepts work over time:
+
+- :mod:`saturn_tpu.service.queue` — thread-safe submission queue with typed
+  :class:`JobRequest` and the job lifecycle state machine
+  (QUEUED → PROFILING → SCHEDULED → RUNNING → DONE/FAILED/EVICTED).
+- :mod:`saturn_tpu.service.admission` — admission controller: profiles
+  arrivals through the profile cache / cost-model pruning (warm arrivals
+  admit in O(cache lookup), zero trials), rejects or defers work that cannot
+  fit the mesh, computes priority/deadline weights for the solver objective.
+- :mod:`saturn_tpu.service.server` — the service loop: drain arrivals,
+  retire completions, incremental warm-started re-solve each interval,
+  ElasticReplanner fallback on admission pressure or topology change.
+- :mod:`saturn_tpu.service.client` — in-process client
+  (``submit / status / wait / cancel``) and the ``python -m
+  saturn_tpu.service`` CLI that tails the JSONL metrics stream.
+
+See ``docs/architecture.md`` ("Online service") for the state machine and
+the divergence notes in ``docs/parity.md``.
+"""
+
+from saturn_tpu.service.admission import AdmissionController, AdmissionDecision
+from saturn_tpu.service.client import ServiceClient
+from saturn_tpu.service.queue import (
+    JobRecord,
+    JobRequest,
+    JobState,
+    SubmissionQueue,
+)
+from saturn_tpu.service.server import SaturnService
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionDecision",
+    "JobRecord",
+    "JobRequest",
+    "JobState",
+    "SaturnService",
+    "ServiceClient",
+    "SubmissionQueue",
+]

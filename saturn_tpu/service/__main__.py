@@ -1,0 +1,7 @@
+"""``python -m saturn_tpu.service`` — tail a service's JSONL metrics stream."""
+
+import sys
+
+from saturn_tpu.service.client import main
+
+sys.exit(main())

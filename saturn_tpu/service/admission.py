@@ -1,0 +1,195 @@
+"""Admission control: profile arrivals, gate on fit, weight the objective.
+
+Profiling rides the existing trial-runner stack end to end — persistent
+profile cache first, cost-model (Amdahl) pruning for uncached grids — so a
+*warm* arrival (same model/data/optimizer fingerprint seen before, any
+priority) admits in O(cache lookup) with **zero** trial executions, while a
+cold arrival pays the sweep exactly once across the fleet's lifetime.
+Requeued jobs (preemption round-trips) skip profiling entirely: their
+strategies are already populated in-process.
+
+Fit gating: a job with no feasible strategy that fits the *current* mesh is
+REJECTED on a full-capacity mesh (it will never fit) but DEFERRED when the
+mesh is degraded below its base capacity (a grow event may re-admit it).
+
+Weights: each admitted job gets a solver-objective weight
+
+    w = 2^priority * (1 + est_runtime / max(deadline_slack, est_runtime))
+
+— exponential in priority so integer priority classes strictly dominate,
+with a deadline-urgency boost capped at 2x (a job whose estimated runtime
+already consumes its slack is maximally urgent). ``solver.milp`` folds the
+normalized weights into the objective as a weighted-start-time tiebreak.
+"""
+
+from __future__ import annotations
+
+import logging
+import timeit
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.service.queue import JobRecord, JobState, SubmissionQueue
+from saturn_tpu.utils import metrics
+
+logger = logging.getLogger("saturn_tpu")
+
+ADMIT = "admit"
+REJECT = "reject"
+DEFER = "defer"
+
+
+@dataclass
+class AdmissionDecision:
+    action: str                  # ADMIT | REJECT | DEFER
+    reason: str = ""
+    trials_run: int = 0          # trials the profiling sweep executed
+    weight: float = 0.0          # solver objective weight (ADMIT only)
+    latency_s: float = 0.0       # wall-clock admission latency
+
+
+def _min_feasible_runtime(task) -> float:
+    feas = task.feasible_strategies()
+    return min(s.runtime for s in feas.values()) if feas else 0.0
+
+
+def compute_weight(priority: float, deadline_slack_s: Optional[float],
+                   est_runtime_s: float) -> float:
+    """Priority/deadline weight for the solver objective (see module doc)."""
+    w = 2.0 ** float(priority)
+    if deadline_slack_s is not None:
+        est = max(est_runtime_s, 1e-9)
+        w *= 1.0 + est / max(deadline_slack_s, est)
+    return w
+
+
+class AdmissionController:
+    """Profiles and gates arrivals for :class:`~saturn_tpu.service.server.
+    SaturnService`. Single-threaded: only the server loop calls it."""
+
+    def __init__(
+        self,
+        topology: SliceTopology,
+        queue: SubmissionQueue,
+        technique_names: Optional[List[str]] = None,
+        profile_cache: Any = None,
+        prune: bool = True,
+        parallel_trials: Optional[int] = None,
+    ):
+        self.base_capacity = topology.capacity
+        self.technique_names = technique_names
+        self.profile_cache = profile_cache
+        self.prune = prune
+        self.parallel_trials = parallel_trials
+        self.queue = queue
+
+    def admit(self, rec: JobRecord, topology: SliceTopology) -> AdmissionDecision:
+        """Profile (if needed) and decide one arrival.
+
+        Transitions the record QUEUED -> PROFILING here; the *caller* applies
+        the decision (SCHEDULED on admit after the re-solve, QUEUED on defer,
+        FAILED on reject) — admission decides, the server owns the plan.
+        """
+        t0 = timeit.default_timer()
+        self.queue.mark(rec, JobState.PROFILING)
+        task = rec.task
+
+        trials = 0
+        if not task.feasible_strategies():
+            # Cold (or never-seen) arrival: run the sweep. Warm fingerprints
+            # resolve entirely from the profile cache — zero trials.
+            from saturn_tpu.trial_runner import evaluator
+
+            try:
+                stats = evaluator.search(
+                    [task],
+                    technique_names=self.technique_names,
+                    topology=topology,
+                    profile_cache=self.profile_cache,
+                    prune=self.prune,
+                    parallel_trials=self.parallel_trials,
+                )
+            except Exception as e:
+                dec = AdmissionDecision(
+                    REJECT, reason=f"profiling failed: {e!r}",
+                    latency_s=timeit.default_timer() - t0,
+                )
+                self._note(rec, dec)
+                return dec
+            trials = int((stats or {}).get("trials_run", 0))
+        rec.trials_run += trials
+
+        fits = any(
+            g <= topology.capacity for g in task.feasible_strategies()
+        )
+        if not fits and rec.requeues > 0:
+            # A preempted job re-entering through the queue was already
+            # running: instead of stranding it in DEFER until the mesh
+            # grows back, synthesize a fitting strategy from its measured
+            # anchors — the same Amdahl extrapolation the replanner applies
+            # to jobs that were live when the topology shrank.
+            from saturn_tpu.resilience.replan import ElasticReplanner
+
+            added = ElasticReplanner()._synthesize(task, topology.capacity)
+            if added:
+                logger.info(
+                    "admission: synthesized size(s) %s for requeued %s on "
+                    "the %d-chip mesh", added, rec.job_id, topology.capacity,
+                )
+            fits = any(
+                g <= topology.capacity for g in task.feasible_strategies()
+            )
+        if not fits:
+            degraded = topology.capacity < self.base_capacity
+            dec = AdmissionDecision(
+                DEFER if degraded else REJECT,
+                reason=(
+                    "no feasible strategy fits the degraded mesh "
+                    f"({topology.capacity}/{self.base_capacity} chips)"
+                    if degraded else
+                    f"no feasible strategy fits the mesh "
+                    f"({topology.capacity} chips)"
+                ),
+                trials_run=trials,
+                latency_s=timeit.default_timer() - t0,
+            )
+            self._note(rec, dec)
+            return dec
+
+        slack = None
+        if rec.deadline_at is not None:
+            import time as _time
+
+            slack = rec.deadline_at - _time.monotonic()
+        weight = compute_weight(
+            rec.request.priority, slack, _min_feasible_runtime(task)
+        )
+        rec.weight = weight
+        # Scheduling-only hints: the replanner's eviction policies order by
+        # task.hints["priority"]; profile_cache.task_signature excludes both
+        # keys so they never perturb warm cache hits.
+        hints = getattr(task, "hints", None)
+        if isinstance(hints, dict):
+            hints["priority"] = float(rec.request.priority)
+            if rec.request.deadline_s is not None:
+                hints["deadline"] = float(rec.request.deadline_s)
+        dec = AdmissionDecision(
+            ADMIT, reason="ok", trials_run=trials, weight=weight,
+            latency_s=timeit.default_timer() - t0,
+        )
+        self._note(rec, dec)
+        return dec
+
+    def _note(self, rec: JobRecord, dec: AdmissionDecision) -> None:
+        metrics.event(
+            "job_admitted", job=rec.job_id, task=rec.name,
+            decision=dec.action, reason=dec.reason,
+            trials_run=dec.trials_run, warm=dec.trials_run == 0,
+            weight=round(dec.weight, 6), latency_s=round(dec.latency_s, 6),
+        )
+        logger.info(
+            "admission: %s %s (%s; %d trials, weight %.3f, %.3fs)",
+            rec.job_id, dec.action, dec.reason or "ok", dec.trials_run,
+            dec.weight, dec.latency_s,
+        )

@@ -1,0 +1,150 @@
+"""In-process client API + the ``python -m saturn_tpu.service`` CLI.
+
+The client is a thin veneer over the service's queue: ``submit`` enqueues a
+:class:`JobRequest`, ``status``/``wait`` read the job's lifecycle record,
+``cancel`` requests eviction. It is in-process by design — the service is
+single-host, and the queue's condition variable gives cheap blocking waits;
+a network front-end would wrap exactly this surface.
+
+The CLI needs no live service at all: it tails the JSONL metrics stream
+(``utils.metrics.tail_events``) that any service run appends to, folds the
+``job_*`` lifecycle events into a queue view, and prints it — so an operator
+can watch (or post-mortem) a run from a separate process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from saturn_tpu.service.queue import JobRequest
+
+
+class ServiceClient:
+    """submit / status / wait / cancel against a running SaturnService."""
+
+    def __init__(self, service):
+        self._service = service
+
+    def submit(self, task, priority: float = 0.0,
+               deadline_s: Optional[float] = None,
+               max_retries: int = 1) -> str:
+        """Enqueue a task; returns the job id."""
+        rec = self._service.queue.submit(JobRequest(
+            task=task, priority=priority, deadline_s=deadline_s,
+            max_retries=max_retries,
+        ))
+        return rec.job_id
+
+    def status(self, job_id: str) -> dict:
+        """Point-in-time snapshot of the job's lifecycle record."""
+        return self._service.queue.get(job_id).snapshot()
+
+    def wait(self, job_id: str, timeout: Optional[float] = None) -> dict:
+        """Block until the job is DONE/FAILED/EVICTED; raises
+        ``TimeoutError`` otherwise."""
+        return self._service.queue.wait(job_id, timeout).snapshot()
+
+    def cancel(self, job_id: str) -> bool:
+        """Request eviction; False if the job already reached a terminal
+        state."""
+        return self._service.queue.cancel(job_id)
+
+
+# ------------------------------------------------------------------- CLI
+_LIFECYCLE_KINDS = (
+    "job_submitted", "job_admitted", "job_scheduled", "job_completed",
+    "job_failed", "job_evicted", "queue_depth",
+)
+
+
+def _fold(rec: dict, jobs: dict) -> None:
+    kind, job = rec.get("kind"), rec.get("job")
+    if not job:
+        return
+    j = jobs.setdefault(job, {"job": job, "task": rec.get("task"),
+                              "state": "QUEUED", "detail": ""})
+    if kind == "job_admitted":
+        dec = rec.get("decision", "admit")
+        if dec == "admit":
+            j["state"] = "ADMITTED"
+            j["detail"] = ("warm" if rec.get("warm") else
+                           f"{rec.get('trials_run', 0)} trials")
+        elif dec == "defer":
+            j["state"] = "DEFERRED"
+            j["detail"] = rec.get("reason", "")
+        else:
+            j["state"] = "REJECTED"
+            j["detail"] = rec.get("reason", "")
+    elif kind == "job_scheduled":
+        j["state"] = "SCHEDULED"
+        start = rec.get("start_s")
+        j["detail"] = f"start +{start:.1f}s" if start is not None else ""
+    elif kind == "job_completed":
+        j["state"] = "DONE"
+        wait = rec.get("wait_s")
+        j["detail"] = f"wait {wait:.2f}s" if wait is not None else ""
+    elif kind == "job_failed":
+        j["state"] = "FAILED"
+        j["detail"] = rec.get("error", "")
+    elif kind == "job_evicted":
+        j["state"] = "EVICTED"
+        j["detail"] = rec.get("reason", "")
+
+
+def _render(jobs: dict, depth) -> str:
+    lines = [f"{'JOB':<22} {'TASK':<14} {'STATE':<10} DETAIL"]
+    for j in jobs.values():
+        lines.append(
+            f"{j['job']:<22} {str(j['task']):<14} {j['state']:<10} "
+            f"{j['detail']}"
+        )
+    if depth is not None:
+        lines.append(f"queue depth: {depth.get('depth')} waiting, "
+                     f"{depth.get('live')} live, "
+                     f"{depth.get('active')} in plan")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m saturn_tpu.service",
+        description="Tail a saturn_tpu service's JSONL metrics stream as a "
+                    "live queue view.",
+    )
+    p.add_argument("metrics_path", help="JSONL file the service writes "
+                                        "(SaturnService(metrics_path=...))")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="keep tailing for new events (Ctrl-C to stop)")
+    p.add_argument("--events", action="store_true",
+                   help="print raw lifecycle events instead of the table")
+    args = p.parse_args(argv)
+
+    from saturn_tpu.utils.metrics import tail_events
+
+    jobs: dict = {}
+    depth = None
+    try:
+        for rec in tail_events(args.metrics_path, follow=args.follow):
+            if rec.get("kind") not in _LIFECYCLE_KINDS:
+                continue
+            if args.events:
+                print({k: v for k, v in rec.items() if k != "ts"})
+                continue
+            if rec["kind"] == "queue_depth":
+                depth = rec
+            else:
+                _fold(rec, jobs)
+            if args.follow:
+                print(f"-- {rec['kind']}: "
+                      f"{rec.get('job') or ''} {rec.get('task') or ''}")
+    except KeyboardInterrupt:
+        pass
+    if not args.events:
+        print(_render(jobs, depth))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

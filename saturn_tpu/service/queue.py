@@ -1,0 +1,302 @@
+"""Submission queue + job lifecycle state machine for the online service.
+
+One queue object is the single source of truth for every job the service has
+ever seen: arrivals wait here, the server drains them at interval
+boundaries, preemptions requeue *through the same queue* (the requeued job
+re-admits warm — its strategies are already profiled), and clients block on
+the queue's condition variable in ``wait()``.
+
+State machine (enforced — an illegal transition raises)::
+
+    QUEUED ──► PROFILING ──► SCHEDULED ──► RUNNING ──► DONE
+      ▲            │             │            │
+      │            ├─► FAILED    │            ├─► FAILED
+      │            │             │            │
+      └────────────┴◄────────────┴────────────┘   (defer / preemption
+                   └─► EVICTED (any non-terminal)  requeue)
+
+``DONE``, ``FAILED`` and ``EVICTED`` are terminal.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from saturn_tpu.utils import metrics
+
+
+class JobState(str, enum.Enum):
+    QUEUED = "QUEUED"          # submitted, waiting for the admission drain
+    PROFILING = "PROFILING"    # admission controller profiling / cache lookup
+    SCHEDULED = "SCHEDULED"    # in the live plan, waiting for its start slot
+    RUNNING = "RUNNING"        # technique launched at least once
+    DONE = "DONE"              # all batches complete
+    FAILED = "FAILED"          # rejected, or failed past its retry budget
+    EVICTED = "EVICTED"        # cancelled, or shed by a replan/pressure policy
+
+
+TERMINAL_STATES = frozenset(
+    {JobState.DONE, JobState.FAILED, JobState.EVICTED}
+)
+
+#: Legal transitions. QUEUED is re-enterable from PROFILING (admission
+#: defers work that cannot fit the current mesh), SCHEDULED (replan dropped
+#: the slot) and RUNNING (preemption requeues through the queue); EVICTED is
+#: reachable from every non-terminal state (cancel / pressure shedding).
+_TRANSITIONS: Dict[JobState, frozenset] = {
+    JobState.QUEUED: frozenset({JobState.PROFILING, JobState.EVICTED}),
+    JobState.PROFILING: frozenset(
+        {JobState.SCHEDULED, JobState.QUEUED, JobState.FAILED, JobState.EVICTED}
+    ),
+    JobState.SCHEDULED: frozenset(
+        {JobState.RUNNING, JobState.QUEUED, JobState.FAILED, JobState.EVICTED}
+    ),
+    JobState.RUNNING: frozenset(
+        {JobState.DONE, JobState.FAILED, JobState.QUEUED, JobState.EVICTED}
+    ),
+    JobState.DONE: frozenset(),
+    JobState.FAILED: frozenset(),
+    JobState.EVICTED: frozenset(),
+}
+
+
+@dataclass
+class JobRequest:
+    """What a client submits: a profiled-or-profilable task plus policy."""
+
+    task: object                       # a Task (or duck-typed equivalent)
+    priority: float = 0.0              # higher = more urgent (solver weight
+    #                                    and eviction ordering)
+    deadline_s: Optional[float] = None  # seconds from submission; admission
+    #                                     pressure sheds work to protect it
+    max_retries: int = 1               # extra attempts after a task failure
+    #                                    (preemptions never consume these)
+
+
+@dataclass
+class JobRecord:
+    """The queue's view of one job across its whole lifetime."""
+
+    job_id: str
+    request: JobRequest
+    state: JobState = JobState.QUEUED
+    submitted_at: float = 0.0          # time.monotonic() timestamps
+    admitted_at: Optional[float] = None
+    scheduled_at: Optional[float] = None
+    started_at: Optional[float] = None   # first RUNNING transition only
+    finished_at: Optional[float] = None
+    deadline_at: Optional[float] = None  # submitted_at + deadline_s
+    attempts: int = 0                  # failed attempts so far
+    requeues: int = 0                  # preemption/defer round-trips
+    trials_run: int = 0                # profiling trials admission executed
+    weight: float = 0.0                # solver objective weight
+    error: Optional[str] = None
+    cancel_requested: bool = False
+
+    @property
+    def task(self):
+        return self.request.task
+
+    @property
+    def name(self) -> str:
+        return self.request.task.name
+
+    def snapshot(self) -> dict:
+        """Client-facing view — plain data, safe to hold across states."""
+        return {
+            "job_id": self.job_id,
+            "task": self.name,
+            "state": self.state.value,
+            "priority": self.request.priority,
+            "deadline_s": self.request.deadline_s,
+            "submitted_at": self.submitted_at,
+            "admitted_at": self.admitted_at,
+            "scheduled_at": self.scheduled_at,
+            "started_at": self.started_at,
+            "finished_at": self.finished_at,
+            "attempts": self.attempts,
+            "requeues": self.requeues,
+            "trials_run": self.trials_run,
+            "weight": self.weight,
+            "error": self.error,
+        }
+
+
+class SubmissionQueue:
+    """Thread-safe arrival queue + job registry.
+
+    Clients submit from any thread; the server drains at interval
+    boundaries. All state transitions go through :meth:`mark` so the
+    lifecycle invariants hold no matter which thread drives them (client,
+    server loop, or an engine launcher thread firing ``on_task_start``).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._jobs: Dict[str, JobRecord] = {}
+        self._arrivals: List[str] = []   # job_ids waiting for the next drain
+        self._seq = 0
+
+    # ------------------------------------------------------------ submission
+    def submit(self, request: JobRequest) -> JobRecord:
+        """Register a job and place it on the arrival queue.
+
+        Task names must be unique among *live* (non-terminal) jobs — every
+        downstream subsystem (plan, engine events, checkpoints) keys on
+        ``task.name``. Resubmitting a name whose previous job finished is
+        fine.
+        """
+        name = getattr(request.task, "name", None)
+        if not name:
+            raise ValueError("JobRequest.task must have a non-empty .name")
+        with self._lock:
+            for rec in self._jobs.values():
+                if rec.name == name and rec.state not in TERMINAL_STATES:
+                    raise ValueError(
+                        f"task name {name!r} is already live as {rec.job_id} "
+                        f"({rec.state.value}) — task names must be unique "
+                        "among active jobs"
+                    )
+            self._seq += 1
+            now = time.monotonic()
+            rec = JobRecord(
+                job_id=f"j{self._seq:04d}-{name}",
+                request=request,
+                submitted_at=now,
+                deadline_at=(
+                    now + request.deadline_s
+                    if request.deadline_s is not None else None
+                ),
+            )
+            self._jobs[rec.job_id] = rec
+            self._arrivals.append(rec.job_id)
+            self._cond.notify_all()
+        metrics.event(
+            "job_submitted", job=rec.job_id, task=name,
+            priority=request.priority, deadline_s=request.deadline_s,
+        )
+        return rec
+
+    def requeue(self, rec: JobRecord) -> None:
+        """Put an admitted job back on the arrival queue (defer, replan drop,
+        or preemption). Re-admission is warm: the task keeps its profiled
+        strategies, so the controller readmits in O(cache lookup)."""
+        with self._lock:
+            if rec.state is not JobState.QUEUED:
+                self.mark(rec, JobState.QUEUED)
+            rec.requeues += 1
+            if rec.job_id not in self._arrivals:
+                self._arrivals.append(rec.job_id)
+            self._cond.notify_all()
+
+    def drain(self) -> List[JobRecord]:
+        """Take every waiting arrival (FIFO). Called by the server at each
+        interval boundary."""
+        with self._lock:
+            ids, self._arrivals = self._arrivals, []
+            return [self._jobs[i] for i in ids]
+
+    def wait_for_arrival(self, timeout: Optional[float] = None) -> bool:
+        """Block until at least one arrival is waiting (idle-server parking;
+        avoids a busy drain loop). Returns whether anything is waiting."""
+        with self._lock:
+            if not self._arrivals:
+                self._cond.wait(timeout)
+            return bool(self._arrivals)
+
+    # ------------------------------------------------------------ lifecycle
+    def mark(self, rec: JobRecord, state: JobState, *,
+             error: Optional[str] = None) -> None:
+        """Transition a job, stamping timestamps. Illegal transitions raise
+        — a state-machine violation is a server bug, not a runtime condition
+        to paper over."""
+        with self._lock:
+            if state not in _TRANSITIONS[rec.state]:
+                raise RuntimeError(
+                    f"illegal job transition {rec.state.value} -> "
+                    f"{state.value} for {rec.job_id}"
+                )
+            rec.state = state
+            now = time.monotonic()
+            if state is JobState.SCHEDULED:
+                if rec.admitted_at is None:  # first admission outcome
+                    rec.admitted_at = now
+                rec.scheduled_at = now
+            elif state is JobState.RUNNING and rec.started_at is None:
+                rec.started_at = now
+            elif state in TERMINAL_STATES:
+                rec.finished_at = now
+            if error is not None:
+                rec.error = error
+            self._cond.notify_all()
+
+    # -------------------------------------------------------------- queries
+    def get(self, job_id: str) -> JobRecord:
+        with self._lock:
+            try:
+                return self._jobs[job_id]
+            except KeyError:
+                raise KeyError(f"unknown job id {job_id!r}") from None
+
+    def jobs(self) -> List[JobRecord]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def depth(self) -> int:
+        """Jobs waiting for admission (QUEUED or PROFILING) — the
+        ``queue_depth`` metric."""
+        with self._lock:
+            return sum(
+                1 for r in self._jobs.values()
+                if r.state in (JobState.QUEUED, JobState.PROFILING)
+            )
+
+    def live(self) -> int:
+        """Jobs in any non-terminal state."""
+        with self._lock:
+            return sum(
+                1 for r in self._jobs.values()
+                if r.state not in TERMINAL_STATES
+            )
+
+    def wait(self, job_id: str, timeout: Optional[float] = None) -> JobRecord:
+        """Block until the job reaches a terminal state (or raise
+        ``TimeoutError``)."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        with self._lock:
+            rec = self.get(job_id)
+            while rec.state not in TERMINAL_STATES:
+                remaining = (
+                    deadline - time.monotonic()
+                    if deadline is not None else None
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"job {job_id} still {rec.state.value} after "
+                        f"{timeout}s"
+                    )
+                self._cond.wait(remaining)
+            return rec
+
+    def cancel(self, job_id: str) -> bool:
+        """Request cancellation. A still-QUEUED job is evicted immediately;
+        an admitted job is flagged and the server evicts it at the next
+        interval boundary. Returns False if the job is already terminal."""
+        with self._lock:
+            rec = self.get(job_id)
+            if rec.state in TERMINAL_STATES:
+                return False
+            rec.cancel_requested = True
+            if rec.state is JobState.QUEUED:
+                self._arrivals = [i for i in self._arrivals if i != job_id]
+                self.mark(rec, JobState.EVICTED, error="cancelled")
+                metrics.event("job_evicted", job=rec.job_id, task=rec.name,
+                              reason="cancelled")
+            return True

@@ -1,0 +1,455 @@
+"""The service loop: an always-on incremental SPASE scheduler.
+
+Reuses the batch orchestrator's machinery wholesale — ``engine.forecast`` /
+``engine.execute`` for the gang-executed interval, ``milp.resolve`` for the
+introspective re-solve, ``fold_realized_feedback`` for the estimate loop,
+the ElasticReplanner for topology changes — but runs forever, folding queue
+arrivals into the live plan at every interval boundary:
+
+    loop:  health poll -> drain arrivals (admission) -> cancel sweep ->
+           admission-pressure shed -> incremental warm-started re-solve ->
+           forecast -> gang-execute -> feedback fold ->
+           requeue preempted / retry failed / retire completed
+
+The re-solve is *incremental*: ``milp.solve`` extends the previous plan's
+fix-and-optimize warm start by inserting new arrivals into free
+(block, time) slots (``warm_schedule(insert_missing=True)``), so an arrival
+never degrades the incumbent the solver starts from, and per-job
+priority/deadline weights bias the objective's start-time tiebreak.
+
+Single-host only (the service mutates the task set from one process's
+view; multi-controller queue consensus is future work — see
+``docs/parity.md``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import timeit
+from typing import Any, Dict, List, Optional
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.executor import engine
+from saturn_tpu.executor.orchestrator import (
+    _handle_topology_change,
+    fold_realized_feedback,
+)
+from saturn_tpu.service.admission import (
+    ADMIT,
+    DEFER,
+    AdmissionController,
+    compute_weight,
+)
+from saturn_tpu.service.queue import JobRecord, JobState, SubmissionQueue
+from saturn_tpu.solver import milp
+from saturn_tpu.utils import metrics
+
+logger = logging.getLogger("saturn_tpu")
+
+
+class SaturnService:
+    """Long-running scheduler over one slice topology.
+
+    ``start()`` launches the loop on a daemon thread; submit through a
+    :class:`~saturn_tpu.service.client.ServiceClient` (or ``self.queue``
+    directly); ``stop()`` drains live work then exits (``stop(abort=True)``
+    evicts everything still live).
+    """
+
+    def __init__(
+        self,
+        topology: Optional[SliceTopology] = None,
+        interval: float = 1.0,
+        threshold: float = 0.0,
+        solver_time_limit: Optional[float] = None,
+        metrics_path: Optional[str] = None,
+        technique_names: Optional[List[str]] = None,
+        profile_cache: Any = None,
+        prune: bool = True,
+        parallel_trials: Optional[int] = None,
+        health_monitor=None,
+        fault_injector=None,
+        recovery_policy: str = "pause-resolve-resume",
+        replan_degrade_factor: float = 2.0,
+        pressure_policy: str = "evict-lowest-priority",
+        poll_s: float = 0.05,
+        log: bool = False,
+    ):
+        if log:
+            logging.basicConfig(level=logging.INFO)
+        from saturn_tpu.core import distributed
+
+        if distributed.is_multihost():
+            raise ValueError("the online service is single-host only")
+        self.topology = topology if topology is not None else SliceTopology()
+        self._base_topo = self.topology
+        self.interval = interval
+        self.threshold = threshold
+        self.solver_time_limit = (
+            solver_time_limit if solver_time_limit is not None
+            else interval / 2
+        )
+        self.metrics_path = metrics_path
+        self.poll_s = poll_s
+        self.pressure_policy = pressure_policy
+
+        self.queue = SubmissionQueue()
+        self.admission = AdmissionController(
+            self.topology, self.queue, technique_names=technique_names,
+            profile_cache=profile_cache, prune=prune,
+            parallel_trials=parallel_trials,
+        )
+
+        if fault_injector is None:
+            from saturn_tpu.resilience.faults import FaultInjector
+
+            fault_injector = FaultInjector.from_env()
+        if fault_injector is not None and health_monitor is None:
+            from saturn_tpu.resilience.health import FleetHealthMonitor
+
+            health_monitor = FleetHealthMonitor.for_topology(self.topology)
+        self.health = health_monitor
+        self.faults = fault_injector
+        self.replanner = None
+        if self.health is not None:
+            from saturn_tpu.resilience.replan import ElasticReplanner
+
+            self.replanner = ElasticReplanner(
+                policy=recovery_policy, degrade_factor=replan_degrade_factor
+            )
+
+        self._stop = threading.Event()
+        self._abort = threading.Event()
+        self._ready = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # -------------------------------------------------------------- control
+    def start(self) -> "SaturnService":
+        if self._thread is not None:
+            raise RuntimeError("service already started")
+        self._thread = threading.Thread(
+            target=self._run_guarded, name="saturn-service", daemon=True
+        )
+        self._thread.start()
+        # Wait for the loop to configure its metrics scope: a submit racing
+        # ahead of it would drop the job_submitted event.
+        self._ready.wait(timeout=10.0)
+        if self._error is not None:
+            raise RuntimeError("service failed to start") from self._error
+        return self
+
+    def stop(self, abort: bool = False, timeout: Optional[float] = None) -> None:
+        """Stop the loop. Default drains: live jobs (and anything already
+        queued) run to completion first. ``abort=True`` evicts all live work
+        at the next interval boundary instead."""
+        if abort:
+            self._abort.set()
+        self._stop.set()  # an idle loop re-checks every poll_s
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self._error is not None:
+            raise RuntimeError("service loop crashed") from self._error
+
+    def _run_guarded(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:  # surfaced by stop()/wait()
+            self._error = e
+            self._ready.set()
+            logger.exception("service loop crashed")
+            # fail every live job so client wait() calls unblock
+            for rec in self.queue.jobs():
+                if rec.state not in (
+                    JobState.DONE, JobState.FAILED, JobState.EVICTED
+                ):
+                    try:
+                        self.queue.mark(
+                            rec, JobState.FAILED,
+                            error=f"service crashed: {e!r}",
+                        )
+                    except RuntimeError:
+                        pass
+
+    # ----------------------------------------------------------------- loop
+    def _run(self) -> None:
+        topo = self.topology
+        tlimit = self.solver_time_limit
+        plan: Optional[milp.Plan] = None
+        jobs: Dict[str, JobRecord] = {}   # task name -> live admitted record
+        interval_index = 0
+
+        with metrics.scoped(self.metrics_path):
+            self._ready.set()
+            while True:
+                if self._stop.is_set():
+                    if self._abort.is_set():
+                        for rec in list(jobs.values()):
+                            self._evict(jobs, rec, "service aborted")
+                        for rec in self.queue.drain():
+                            self.queue.mark(rec, JobState.EVICTED,
+                                            error="service aborted")
+                            metrics.event("job_evicted", job=rec.job_id,
+                                          task=rec.name,
+                                          reason="service aborted")
+                    if not jobs and self.queue.depth() == 0:
+                        break
+                elif not jobs and self.queue.depth() == 0:
+                    # idle: park on the queue condition, no busy loop
+                    self.queue.wait_for_arrival(timeout=self.poll_s)
+                    continue
+
+                # 1. health poll / topology change (elastic hook, as in the
+                #    batch loop)
+                if self.health is not None:
+                    if self.faults is not None:
+                        self.faults.apply_due(interval_index, self.health)
+                    change = self.health.poll()
+                    if change is not None and change.kind in ("shrink", "grow"):
+                        evicted_names: dict = {}
+                        tasks = [r.task for r in jobs.values()]
+                        tasks, topo, plan = _handle_topology_change(
+                            tasks, self._base_topo, self.health,
+                            self.replanner, change, plan, tlimit,
+                            evicted_names,
+                        )
+                        for name in evicted_names:
+                            rec = jobs.pop(name, None)
+                            if rec is not None:
+                                self.queue.mark(
+                                    rec, JobState.EVICTED,
+                                    error=evicted_names[name],
+                                )
+                                metrics.event(
+                                    "job_evicted", job=rec.job_id,
+                                    task=name, reason="topology-change",
+                                )
+                    elif change is not None:  # degrade: advisory only
+                        metrics.event("topology_change", **change.to_fields())
+
+                # 2. drain arrivals through admission
+                newly_admitted: List[JobRecord] = []
+                for rec in self.queue.drain():
+                    if rec.cancel_requested:
+                        self.queue.mark(rec, JobState.EVICTED,
+                                        error="cancelled")
+                        metrics.event("job_evicted", job=rec.job_id,
+                                      task=rec.name, reason="cancelled")
+                        continue
+                    dec = self.admission.admit(rec, topo)
+                    if dec.action == ADMIT:
+                        jobs[rec.name] = rec
+                        newly_admitted.append(rec)
+                    elif dec.action == DEFER:
+                        self.queue.requeue(rec)
+                    else:  # REJECT
+                        self.queue.mark(rec, JobState.FAILED,
+                                        error=dec.reason)
+
+                # 3. cancel sweep over admitted jobs
+                for rec in list(jobs.values()):
+                    if rec.cancel_requested:
+                        self._evict(jobs, rec, "cancelled")
+
+                # 4. admission pressure: if the greedy projection blows the
+                #    tightest deadline, shed low-priority work through the
+                #    replanner's eviction policy (same code path a topology
+                #    shrink uses).
+                self._shed_pressure(jobs, topo, plan)
+
+                if not jobs:
+                    plan = None
+                    metrics.event("queue_depth", depth=self.queue.depth(),
+                                  live=self.queue.live(), active=0)
+                    interval_index += 1
+                    if self.queue.depth():
+                        # only deferred work left (e.g. waiting out a
+                        # degraded mesh): don't spin the drain/defer cycle
+                        time.sleep(self.poll_s)
+                    continue
+
+                # 5. incremental re-solve, warm-started from the live plan,
+                #    weighted by priority/deadline urgency (recomputed each
+                #    cycle: slack shrinks as deadlines approach)
+                tasks = [r.task for r in jobs.values()]
+                weights = {
+                    r.name: self._weight(r) for r in jobs.values()
+                }
+                t_solve = timeit.default_timer()
+                plan = milp.resolve(
+                    tasks, topo, plan, self.interval, self.threshold,
+                    tlimit, weights=weights,
+                )
+                metrics.event(
+                    "solve", makespan_s=plan.makespan, n_tasks=len(tasks),
+                    solve_s=round(timeit.default_timer() - t_solve, 6),
+                )
+                for rec in newly_admitted:
+                    if rec.name not in jobs:
+                        continue  # evicted by the cancel sweep / load shed
+                    a = plan.assignments.get(rec.name)
+                    self.queue.mark(rec, JobState.SCHEDULED)
+                    metrics.event(
+                        "job_scheduled", job=rec.job_id, task=rec.name,
+                        start_s=a.start if a else None,
+                        size=a.apportionment if a else None,
+                        weight=round(rec.weight, 6),
+                    )
+
+                # 6. forecast + gang-execute one interval
+                run_tasks, batches, completed = engine.forecast(
+                    tasks, self.interval, plan
+                )
+                errors: dict = {}
+                if run_tasks:
+                    errors = engine.execute(
+                        run_tasks, batches, self.interval, plan, topo,
+                        failure_policy="drop", health=self.health,
+                        faults=self.faults, interval_index=interval_index,
+                        on_task_start=self._make_on_start(jobs),
+                    )
+                else:
+                    # every start is beyond this interval: resolve() slides
+                    # work forward next cycle; don't spin
+                    time.sleep(min(self.poll_s, self.interval))
+
+                # 7. estimate feedback (EWMA fold + profile-cache write-back)
+                for name, (old, new) in sorted(
+                    fold_realized_feedback(run_tasks).items()
+                ):
+                    metrics.event("estimate_update", task=name,
+                                  profiled_s=round(old, 6),
+                                  updated_s=round(new, 6))
+
+                from saturn_tpu.resilience.faults import PreemptedError
+
+                preempted = {n: e for n, e in errors.items()
+                             if isinstance(e, PreemptedError)}
+                failed = {n: e for n, e in errors.items()
+                          if n not in preempted}
+
+                # 8. preemptions requeue THROUGH THE QUEUE — the fleet's
+                #    fault, no retry consumed; re-admission is warm (the
+                #    strategies survive on the task object).
+                for name, err in sorted(preempted.items()):
+                    rec = jobs.pop(name)
+                    self._release(rec.task, compiled=False)
+                    engine.rollback_forecast(rec.task, batches.get(name, 0))
+                    metrics.event("task_preempted", task=name,
+                                  error=repr(err))
+                    self.queue.requeue(rec)
+                completed = [t for t in completed if t.name not in preempted]
+
+                # 9. real failures: retry within the job's budget, else FAIL
+                for name, err in sorted(failed.items()):
+                    rec = jobs[name]
+                    rec.attempts += 1
+                    self._release(rec.task, compiled=False)
+                    if rec.attempts <= rec.request.max_retries:
+                        engine.rollback_forecast(
+                            rec.task, batches.get(name, 0)
+                        )
+                        metrics.event("task_retry", task=name,
+                                      attempt=rec.attempts, error=repr(err))
+                    else:
+                        jobs.pop(name)
+                        self._release(rec.task, compiled=True)
+                        self.queue.mark(rec, JobState.FAILED,
+                                        error=repr(err))
+                        metrics.event("task_failed", task=name,
+                                      error=repr(err))
+                        metrics.event("job_failed", job=rec.job_id,
+                                      task=name, error=repr(err))
+                completed = [t for t in completed if t.name not in failed]
+
+                # 10. retire completions
+                for t in completed:
+                    rec = jobs.pop(t.name)
+                    self._release(rec.task, compiled=True)
+                    self.queue.mark(rec, JobState.DONE)
+                    metrics.event("task_completed", task=t.name)
+                    metrics.event(
+                        "job_completed", job=rec.job_id, task=t.name,
+                        wait_s=round(
+                            (rec.started_at or rec.finished_at)
+                            - rec.submitted_at, 6,
+                        ),
+                        attempts=rec.attempts, requeues=rec.requeues,
+                    )
+
+                metrics.event("queue_depth", depth=self.queue.depth(),
+                              live=self.queue.live(), active=len(jobs))
+                interval_index += 1
+
+        logger.info("service loop exited (%d jobs seen)",
+                    len(self.queue.jobs()))
+
+    # --------------------------------------------------------------- helpers
+    def _weight(self, rec: JobRecord) -> float:
+        slack = None
+        if rec.deadline_at is not None:
+            slack = rec.deadline_at - time.monotonic()
+        feas = rec.task.feasible_strategies()
+        est = min((s.runtime for s in feas.values()), default=0.0)
+        rec.weight = compute_weight(rec.request.priority, slack, est)
+        return rec.weight
+
+    def _make_on_start(self, jobs: Dict[str, JobRecord]):
+        def on_start(name: str) -> None:
+            rec = jobs.get(name)
+            if rec is not None and rec.state is JobState.SCHEDULED:
+                self.queue.mark(rec, JobState.RUNNING)
+
+        return on_start
+
+    def _evict(self, jobs: Dict[str, JobRecord], rec: JobRecord,
+               reason: str) -> None:
+        jobs.pop(rec.name, None)
+        self._release(rec.task, compiled=True)
+        self.queue.mark(rec, JobState.EVICTED, error=reason)
+        metrics.event("job_evicted", job=rec.job_id, task=rec.name,
+                      reason=reason)
+
+    @staticmethod
+    def _release(task, compiled: bool) -> None:
+        release = getattr(task, "release_live_state", None)
+        if release is not None:
+            release()
+        if compiled:
+            release_c = getattr(task, "release_compiled", None)
+            if release_c is not None:
+                release_c()
+
+    def _shed_pressure(self, jobs: Dict[str, JobRecord], topo,
+                       plan: Optional[milp.Plan]) -> None:
+        """Deadline-protecting load shed. The tightest remaining deadline
+        slack bounds the projected (greedy, pessimistic) makespan; when the
+        projection overshoots, the configured replanner eviction policy
+        picks the casualties — lowest ``hints['priority']`` first."""
+        with_deadline = [r for r in jobs.values()
+                         if r.deadline_at is not None]
+        if not with_deadline or len(jobs) <= 1:
+            return
+        limit = min(r.deadline_at for r in with_deadline) - time.monotonic()
+        limit = max(limit, 1e-3)
+        tasks = [r.task for r in jobs.values()]
+        proj = milp.greedy_plan(tasks, topo).makespan
+        if proj <= limit:
+            return
+        from saturn_tpu.resilience.replan import ReplanContext, get_policy
+
+        ctx = ReplanContext(
+            topology=topo, previous_plan=plan, previous_makespan=limit,
+            change_kind="admission-pressure", degrade_factor=1.0,
+        )
+        _keep, shed = get_policy(self.pressure_policy)(tasks, ctx)
+        for t in shed:
+            rec = jobs.get(t.name)
+            if rec is not None:
+                logger.warning(
+                    "admission pressure: evicting %s (projection %.2fs > "
+                    "slack %.2fs)", rec.job_id, proj, limit,
+                )
+                self._evict(jobs, rec, "admission-pressure")

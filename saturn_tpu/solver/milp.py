@@ -169,6 +169,8 @@ def warm_schedule(
     topology: SliceTopology,
     previous: Plan,
     ordering_slack: float = 1.0,
+    insert_missing: bool = False,
+    weights: Optional[Dict[str, float]] = None,
 ) -> Optional[Plan]:
     """Fix-and-optimize warm start: keep each task's previous (size, block)
     choice, list-schedule starts under CURRENT runtimes in previous start
@@ -176,16 +178,26 @@ def warm_schedule(
     Gurobi with last interval's solution (``milp.py:103-104,151-155,323``).
 
     Returns None if any task lacks a previous assignment or its previous
-    choice no longer exists (strategy became infeasible / capacity changed).
+    choice no longer exists (strategy became infeasible / capacity changed) —
+    unless ``insert_missing`` is set, in which case such tasks are appended
+    AFTER the pinned incumbent structure, each at its min-finish
+    (strategy, block) slot, in descending ``weights`` order (priority-first;
+    ties broken longest-first). This is the online service's incremental
+    warm start: one arrival or departure perturbs the live plan instead of
+    invalidating it.
     """
     pinned: List[Tuple[object, int, Block, float]] = []  # (task, size, blk, rt)
+    loose: List = []
     for t in task_list:
         a = previous.assignments.get(t.name)
-        if a is None:
-            return None
-        strat = t.feasible_strategies().get(a.apportionment)
-        if strat is None or a.block.end > topology.capacity:
-            return None
+        strat = (
+            t.feasible_strategies().get(a.apportionment) if a is not None else None
+        )
+        if a is None or strat is None or a.block.end > topology.capacity:
+            if not insert_missing:
+                return None
+            loose.append(t)
+            continue
         pinned.append((t, a.apportionment, a.block, strat.runtime))
 
     # Previous start order preserves the incumbent schedule's structure.
@@ -196,6 +208,30 @@ def warm_schedule(
     for t, size, blk, rt in pinned:
         st = timeline.place(blk, rt, ordering_slack)
         assignments[t.name] = Assignment(size, blk, st, rt)
+
+    w = weights or {}
+    loose.sort(
+        key=lambda t: (
+            -w.get(t.name, 0.0),
+            -min(s.runtime for s in t.feasible_strategies().values()),
+        )
+    )
+    for t in loose:
+        best = None  # (finish, start, size, blk, rt)
+        for size, strat in sorted(t.feasible_strategies().items()):
+            if size > topology.capacity:
+                continue
+            for blk in topology.blocks(size):
+                st = timeline.earliest_free(blk, strat.runtime + ordering_slack)
+                fin = st + strat.runtime
+                if best is None or fin < best[0]:
+                    best = (fin, st, size, blk, strat.runtime)
+        if best is None:
+            return None  # a loose task fits no block: no warm plan exists
+        fin, st, size, blk, rt = best
+        timeline.occupy(blk, st, fin + ordering_slack)
+        assignments[t.name] = Assignment(size, blk, st, rt)
+
     makespan = max((a.start + a.runtime for a in assignments.values()), default=0.0)
     plan = Plan(assignments=assignments, makespan=makespan)
     plan.compute_dependencies()
@@ -209,6 +245,7 @@ def solve(
     ordering_slack: float = 1.0,
     milp_task_limit: int = 12,
     warm: Optional[Plan] = None,
+    weights: Optional[Dict[str, float]] = None,
 ) -> Plan:
     """Build and solve the joint strategy/placement/schedule MILP.
 
@@ -225,7 +262,16 @@ def solve(
     gets the fix-and-optimize makespan as an upper-bound cut (scipy's HiGHS
     wrapper cannot inject an incumbent) and returns the warm plan instead of
     greedy when the time limit strikes out; the native search is seeded with
-    the previous (size, block) choices.
+    the previous (size, block) choices. Tasks absent from ``warm`` (online
+    arrivals) are inserted into the fix-and-optimize incumbent rather than
+    discarding it (``warm_schedule(insert_missing=True)``).
+
+    ``weights`` (task name -> nonnegative urgency, from the service's
+    admission controller) adds a priority term to the objective: among
+    makespan-equal schedules, higher-weight tasks start earlier. The term is
+    scaled to at most ~0.5% of the horizon so it can only reorder, never
+    trade away meaningful makespan — minimizing batch makespan stays the
+    primary objective (the paper's SPASE formulation).
     """
     for t in task_list:
         if not t.feasible_strategies():
@@ -236,7 +282,8 @@ def solve(
             )
 
     wplan = (
-        warm_schedule(task_list, topology, warm, ordering_slack)
+        warm_schedule(task_list, topology, warm, ordering_slack,
+                      insert_missing=True, weights=weights)
         if warm is not None
         else None
     )
@@ -260,7 +307,7 @@ def solve(
             return plan
         if wplan is not None:
             return wplan
-        return greedy_plan(task_list, topology, ordering_slack)
+        return greedy_plan(task_list, topology, ordering_slack, weights=weights)
 
     # Cheap native pass first (~0.1-0.2s at these sizes): its plan is a
     # guaranteed-feasible incumbent that (a) upper-bounds the MILP via a cut
@@ -377,8 +424,20 @@ def solve(
             area = area + xi * (size * rt)
     m.add(makespan >= area * (1.0 / topology.capacity))
 
-    # Tiny pressure toward early starts (keeps solutions canonical).
-    m.minimize(makespan + sum((sta[n] for n in names), Expr()) * (1e-6 / max(len(names), 1)))
+    if weights:
+        # Priority pressure: weighted start times, normalized so the whole
+        # term is <= 0.5% of the horizon — a tie-breaker among makespan-equal
+        # schedules (high-weight tasks start first), never a makespan trade.
+        wsum = sum(max(weights.get(n, 0.0), 0.0) for n in names) or 1.0
+        wterm = Expr()
+        for n in names:
+            wn = max(weights.get(n, 0.0), 0.0)
+            if wn > 0.0:
+                wterm = wterm + sta[n] * (wn / wsum)
+        m.minimize(makespan + wterm * 5e-3)
+    else:
+        # Tiny pressure toward early starts (keeps solutions canonical).
+        m.minimize(makespan + sum((sta[n] for n in names), Expr()) * (1e-6 / max(len(names), 1)))
 
     if incumbent is not None:
         # Incumbent cut (native and/or warm fix-and-optimize plan): feasible,
@@ -394,7 +453,7 @@ def solve(
             log.info("MILP timeout — keeping native/warm incumbent plan")
             return incumbent
         log.warning("MILP infeasible/error — falling back to greedy")
-        return greedy_plan(task_list, topology, ordering_slack)
+        return greedy_plan(task_list, topology, ordering_slack, weights=weights)
 
     assignments: Dict[str, Assignment] = {}
     for t in task_list:
@@ -475,18 +534,24 @@ def makespan_lower_bound(
 
 
 def greedy_plan(
-    task_list: List, topology: SliceTopology, ordering_slack: float = 0.0
+    task_list: List, topology: SliceTopology, ordering_slack: float = 0.0,
+    weights: Optional[Dict[str, float]] = None,
 ) -> Plan:
     """List-scheduling fallback: longest task first, earliest feasible
     (block, time) slot, choosing the strategy that minimizes finish time.
     Used when the MILP times out dry — the reference had no fallback and
     would just fail. With ``ordering_slack`` this is exactly the native
     constructor (``spase.cpp`` LPT order + min-finish choice), via the shared
-    ``DeviceTimeline`` slot rule."""
+    ``DeviceTimeline`` slot rule. ``weights`` prepends a priority key to the
+    LPT order so the fallback respects the service's admission weights."""
     timeline = DeviceTimeline(topology.capacity)
+    w = weights or {}
     order = sorted(
         task_list,
-        key=lambda t: -min(s.runtime for s in t.feasible_strategies().values()),
+        key=lambda t: (
+            -w.get(t.name, 0.0),
+            -min(s.runtime for s in t.feasible_strategies().values()),
+        ),
     )
     assignments: Dict[str, Assignment] = {}
     for t in order:
@@ -521,6 +586,7 @@ def resolve(
     threshold: float = 0.0,
     time_limit: Optional[float] = None,
     warm_budget_frac: float = 0.25,
+    weights: Optional[Dict[str, float]] = None,
 ) -> Plan:
     """Introspective re-solve with compare-and-swap (``milp.py:354-444``).
 
@@ -542,10 +608,13 @@ def resolve(
         # Reduce the budget only when the warm incumbent actually exists —
         # if the task set changed (new task, choice now infeasible) the
         # fix-and-optimize floor is unavailable and the re-solve must get
-        # the full budget like a cold solve.
+        # the full budget like a cold solve. (Arrivals/departures get the
+        # insertion-extended incumbent inside solve(), but its quality for a
+        # changed set is unproven — full budget is the safe default there.)
         if warm_schedule(task_list, topology, previous) is not None:
             tl = max(1.0, time_limit * warm_budget_frac)
-    fresh = solve(task_list, topology, time_limit=tl, warm=previous)
+    fresh = solve(task_list, topology, time_limit=tl, warm=previous,
+                  weights=weights)
     if previous is None:
         return fresh
 

@@ -78,7 +78,7 @@ def search(
     profile_cache: Any = None,
     prune: bool = True,
     compile_cache_dir: Optional[str] = None,
-) -> None:
+) -> Dict[str, int]:
     """Fill ``task.strategies`` for every task in place.
 
     ``technique_names=None`` uses the whole library (registering the built-in
@@ -95,6 +95,10 @@ def search(
     ``prune`` toggles anchor-size cost-model pruning. ``compile_cache_dir``
     additionally roots JAX's persistent compilation cache there for this
     process (same effect as ``SATURN_TPU_COMPILE_CACHE_DIR``).
+
+    Returns sweep stats ``{"trials_run", "cache_hits", "pruned",
+    "interpolated"}`` — the online admission controller uses ``trials_run``
+    to distinguish warm (zero-trial) from cold arrivals.
     """
     if log:
         logging.basicConfig(level=logging.INFO)
@@ -102,7 +106,9 @@ def search(
         pcache.maybe_enable_persistent_compile_cache(compile_cache_dir)
     cache = pcache.resolve(profile_cache)
     with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
-        _search_inner(tasks, technique_names, topology, parallel_trials, cache, prune)
+        return _search_inner(
+            tasks, technique_names, topology, parallel_trials, cache, prune
+        )
 
 
 def _default_parallelism(topo: SliceTopology) -> int:
@@ -206,7 +212,7 @@ class _EtaTracker:
 
 def _search_inner(
     tasks, technique_names, topology, parallel_trials=None, cache=None, prune=True
-) -> None:
+) -> Dict[str, int]:
     topo = topology if topology is not None else SliceTopology()
     if technique_names is None and not lib.registered_names():
         lib.register_default_library()
@@ -458,12 +464,14 @@ def _search_inner(
                 anchor_size=nearest,
             )
 
+    n_interp = sum(
+        1 for l in lanes for d in l.done.values() if d[3] == "interpolated"
+    )
     if eta.planned or n_hits:
         logger.info(
             "trial runner: sweep complete — %d trials run, %d cache hits, "
             "%d pruned, %d interpolated",
-            eta.completed, n_hits, eta.pruned,
-            sum(1 for l in lanes for d in l.done.values() if d[3] == "interpolated"),
+            eta.completed, n_hits, eta.pruned, n_interp,
         )
 
     # Seed unsearched sizes with an infeasible dummy (``:96-99``) so the
@@ -472,3 +480,10 @@ def _search_inner(
         for g in topo.valid_sizes():
             if g not in task.strategies:
                 task.strategies[g] = Strategy(None, g, None, DUMMY_RUNTIME)
+
+    return {
+        "trials_run": eta.completed,
+        "cache_hits": n_hits,
+        "pruned": eta.pruned,
+        "interpolated": n_interp,
+    }

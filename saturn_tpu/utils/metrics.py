@@ -85,6 +85,39 @@ def read_events(path: str, kind: Optional[str] = None) -> list:
     return out
 
 
+def tail_events(path: str, kind: Optional[str] = None,
+                poll_s: float = 0.2, stop=None, follow: bool = True):
+    """Generator over a live JSONL metrics stream (``tail -f`` semantics).
+
+    Yields events from the start of the file, then keeps polling for
+    appended lines every ``poll_s`` until ``stop`` (a ``threading.Event``)
+    is set — or returns at EOF when ``follow=False``. A partial trailing
+    line (the writer mid-append) is buffered, not parsed, so a torn tail
+    never raises and never yields a truncated record; the line is delivered
+    once its newline lands."""
+    buf = ""
+    with open(path) as fh:
+        while True:
+            chunk = fh.read(65536)
+            if chunk:
+                buf += chunk
+                while "\n" in buf:
+                    line, buf = buf.split("\n", 1)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if kind is None or rec.get("kind") == kind:
+                        yield rec
+                continue
+            if not follow or (stop is not None and stop.is_set()):
+                return
+            time.sleep(poll_s)
+
+
 @contextlib.contextmanager
 def scoped(path: Optional[str]):
     """Route events to ``path`` for the enclosed region, then restore the

@@ -120,11 +120,19 @@ def task_signature(task: Any) -> str:
     Excludes lr, total batch count and the task name: the reference cloned
     searched tasks across learning rates precisely because lr doesn't change
     step time (``WikiText103.py:87-99``), and runtime is re-derived as
-    ``per_batch_time * total_batches`` at use time.
+    ``per_batch_time * total_batches`` at use time. Scheduling-only hints
+    (``priority``, ``deadline`` — written by the online job service for the
+    replanner's eviction ordering) are likewise excluded: they never touch
+    the compiled program, and the same model submitted at a different
+    priority must stay a warm cache hit.
     """
     hp = getattr(task, "hparams", None)
     kwargs = dict(getattr(hp, "kwargs", {}) or {})
-    hints = dict(getattr(task, "hints", {}) or {})
+    hints = {
+        k: v
+        for k, v in dict(getattr(task, "hints", {}) or {}).items()
+        if k not in ("priority", "deadline")
+    }
     return json.dumps(
         {
             "model": _model_signature(task),

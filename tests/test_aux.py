@@ -55,6 +55,50 @@ class TestMetrics:
         evs = read_events(p)  # every line must be valid JSON (no interleaving)
         assert len(evs) == 200
 
+    def test_read_events_skips_truncated_tail(self, tmp_path):
+        # A live tail of an in-flight run: the writer is mid-append, so the
+        # last line has no newline and is not valid JSON yet.
+        p = str(tmp_path / "torn.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"ts": 1.0, "kind": "solve"}) + "\n")
+            f.write(json.dumps({"ts": 2.0, "kind": "interval"}) + "\n")
+            f.write('{"ts": 3.0, "kind": "tru')  # torn tail, no newline
+        evs = metrics.read_events(p)
+        assert [e["kind"] for e in evs] == ["solve", "interval"]
+        assert metrics.read_events(p, kind="interval")[0]["ts"] == 2.0
+
+    def test_tail_events_buffers_partial_line(self, tmp_path):
+        # tail_events must never yield a truncated record: the torn tail is
+        # buffered and delivered only once its newline lands.
+        p = str(tmp_path / "tail.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"kind": "a"}) + "\n")
+            f.write('{"kind": "b"')  # partial
+        got = list(metrics.tail_events(p, follow=False))
+        assert [e["kind"] for e in got] == ["a"]
+        with open(p, "a") as f:
+            f.write(', "x": 1}\n')
+        got = list(metrics.tail_events(p, follow=False))
+        assert [e["kind"] for e in got] == ["a", "b"]
+        assert got[1]["x"] == 1
+
+
+class TestTopLevelAPI:
+    def test_orchestrate_signature_parity(self):
+        # The top-level wrapper must forward every orchestrator kwarg
+        # explicitly — same names, order and defaults (ISSUE: it used to pin
+        # interval=1000 as an int and hide the rest behind **kw).
+        import inspect
+
+        import saturn_tpu
+        from saturn_tpu.executor.orchestrator import orchestrate as real
+
+        wrap = inspect.signature(saturn_tpu.orchestrate).parameters
+        ref = inspect.signature(real).parameters
+        assert list(wrap) == list(ref)
+        for name, p in ref.items():
+            assert wrap[name].default == p.default, name
+
 
 class TestTrace:
     def test_noop_without_dir(self):

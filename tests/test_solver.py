@@ -319,3 +319,92 @@ class TestWarmStart:
         )
         assert cold is not None and warm is not None
         assert warm.makespan <= max(cold.makespan, prev.makespan) + 1e-6
+
+
+class TestIncrementalWarmStart:
+    """Online-service solver path: arrivals/departures re-solve warm-started
+    from the live plan, with priority weights breaking start-time ties."""
+
+    _rand_tasks = staticmethod(TestWarmStart._rand_tasks)
+
+    def test_insert_missing_extends_warm_plan(self):
+        from saturn_tpu.solver.milp import warm_schedule
+
+        tasks = self._rand_tasks(5, seed=11)
+        prev = solve(tasks, topo(8), time_limit=10.0)
+        newcomer = FakeTask("new", {2: 40.0, 4: 25.0})
+        w = warm_schedule(
+            tasks + [newcomer], topo(8), prev, insert_missing=True
+        )
+        assert w is not None and "new" in w.assignments
+        # incumbents keep their previous (size, block) choices
+        for t in tasks:
+            assert (
+                w.assignments[t.name].apportionment
+                == prev.assignments[t.name].apportionment
+            )
+            assert (
+                w.assignments[t.name].block.offset
+                == prev.assignments[t.name].block.offset
+            )
+        # and the extended plan is feasible (overlaps serialized in time)
+        items = list(w.assignments.values())
+        for i, a in enumerate(items):
+            for b in items[i + 1:]:
+                if a.block.overlaps(b.block):
+                    assert (
+                        a.start + a.runtime <= b.start + 1e-6
+                        or b.start + b.runtime <= a.start + 1e-6
+                    )
+
+    def test_insert_missing_default_off(self):
+        # the historical contract: without insert_missing the warm start
+        # refuses instances whose task set changed
+        from saturn_tpu.solver.milp import warm_schedule
+
+        tasks = self._rand_tasks(4, seed=12)
+        prev = solve(tasks, topo(8), time_limit=10.0)
+        newcomer = FakeTask("new", {4: 50.0})
+        assert warm_schedule(tasks + [newcomer], topo(8), prev) is None
+
+    def test_resolve_with_arrival_not_worse_than_cold(self):
+        """Re-solving with one task ADDED, warm-started from the live plan,
+        must not degrade makespan vs a cold solve of the same instance."""
+        tasks = self._rand_tasks(5, seed=13)
+        prev = solve(tasks, topo(8), time_limit=10.0)
+        newcomer = FakeTask("new", {2: 60.0, 4: 35.0, 8: 22.0})
+        grown = tasks + [newcomer]
+        warm = resolve(grown, topo(8), prev, interval=1.0, time_limit=10.0)
+        cold = solve(grown, topo(8), time_limit=10.0)
+        assert warm.makespan <= cold.makespan + 1e-3
+        assert set(warm.assignments) == {t.name for t in grown}
+
+    def test_resolve_with_departure_not_worse_than_cold(self):
+        """Re-solving with one task REMOVED must not degrade either."""
+        tasks = self._rand_tasks(6, seed=14)
+        prev = solve(tasks, topo(8), time_limit=10.0)
+        shrunk = tasks[:-1]
+        warm = resolve(shrunk, topo(8), prev, interval=1.0, time_limit=10.0)
+        cold = solve(shrunk, topo(8), time_limit=10.0)
+        assert warm.makespan <= cold.makespan + 1e-3
+        assert set(warm.assignments) == {t.name for t in shrunk}
+
+    def test_weights_order_makespan_equal_schedules(self):
+        """Three identical full-mesh tasks serialize; the weighted objective
+        must start the high-weight task first without hurting makespan."""
+        tasks = [FakeTask(n, {8: 50.0}) for n in ("a", "b", "c")]
+        base = solve(tasks, topo(8), time_limit=10.0)
+        w = solve(tasks, topo(8), time_limit=10.0,
+                  weights={"c": 1.0, "a": 0.0, "b": 0.0})
+        assert w.makespan == pytest.approx(base.makespan, rel=0.01)
+        assert w.assignments["c"].start == pytest.approx(0.0, abs=1e-6)
+        assert all(
+            w.assignments[n].start >= 50.0 - 1e-6 for n in ("a", "b")
+        )
+
+    def test_greedy_plan_respects_weights(self):
+        tasks = [FakeTask(n, {8: 30.0}) for n in ("lo", "mid", "hi")]
+        p = greedy_plan(tasks, topo(8),
+                        weights={"hi": 4.0, "mid": 2.0, "lo": 0.0})
+        assert p.assignments["hi"].start == pytest.approx(0.0, abs=1e-9)
+        assert p.assignments["mid"].start < p.assignments["lo"].start
