@@ -1,0 +1,122 @@
+"""Crash-recovery latency vs journal length.
+
+Measures the restart-critical path of the durability layer
+(``saturn_tpu.durability``) as the write-ahead journal grows:
+
+- **recover**: scan every segment, CRC + sequence-verify each record, and
+  quarantine the torn tail (one is planted per run — the realistic restart
+  has a crashed writer's partial append at the end);
+- **replay**: fold the verified records into the service's recovery state
+  (job registry + realized-iteration ledger + last committed plan).
+
+Journals are synthesized with the real ``Journal`` writer (same segment
+rotation, same group-commit batching) over a representative record mix:
+submissions, lifecycle edges, per-interval task_progress and plan commits
+for a rotating population of jobs.
+
+Prints ONE JSON line like ``bench.py``:
+
+    {"metric": "crash_recovery_latency", "points": [
+        {"records": 1000, "segments": ..., "recover_s": ..., "replay_s": ...,
+         "total_s": ...}, ...],
+     "throughput_rec_per_s": ..., "unit": "s"}
+
+Run: ``python benchmarks/crash_recovery.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import timeit
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from saturn_tpu.durability import Journal, recover, replay_service_state
+
+JOURNAL_LENGTHS = (1_000, 10_000, 50_000)
+JOBS = 16               # rotating live-job population
+COMMIT_EVERY = 32       # records per group commit (an interval's batch)
+SEGMENT_MAX = 512 * 1024
+
+
+def synthesize(root: str, n_records: int) -> None:
+    """Write ~n_records of a realistic service-journal mix, ending in a
+    torn trailing record (the crashed writer's un-fsync'd append)."""
+    j = Journal(root, segment_max_bytes=SEGMENT_MAX, sync=False)
+    for i in range(JOBS):
+        j.append("job_submitted", job=f"j{i + 1:04d}-model-{i}",
+                 task=f"model-{i}", priority=float(i % 3),
+                 max_retries=1, total_batches=10_000,
+                 spec={"sizes": [2, 4]})
+    written = JOBS
+    interval = 0
+    while written < n_records:
+        for i in range(JOBS):
+            if written >= n_records:
+                break
+            j.append("task_progress", task=f"model-{i}",
+                     job=f"j{i + 1:04d}-model-{i}", batches=40)
+            written += 1
+            if written % COMMIT_EVERY == 0:
+                j.commit()
+        j.append("plan_commit", interval=interval, makespan=123.4,
+                 plan={"assignments": {f"model-{i}": {"start": 0.0,
+                                                      "apportionment": 4,
+                                                      "block": i % 2}
+                                       for i in range(JOBS)}})
+        written += 1
+        interval += 1
+    j.close()
+    # plant the torn tail recovery always faces after a real crash
+    segs = sorted(n for n in os.listdir(root) if n.endswith(".jsonl"))
+    with open(os.path.join(root, segs[-1]), "ab") as f:
+        f.write(b'{"crc":"00000000","data":{"task":"model-0","ba')
+
+
+def bench_one(n_records: int) -> dict:
+    root = tempfile.mkdtemp(prefix="saturn_bench_wal_")
+    try:
+        synthesize(root, n_records)
+        t0 = timeit.default_timer()
+        report = recover(root)
+        t1 = timeit.default_timer()
+        state = replay_service_state(root)
+        t2 = timeit.default_timer()
+        if not report["quarantined"]:
+            raise SystemExit("planted torn tail was not quarantined")
+        if len(state.jobs) != JOBS:
+            raise SystemExit(
+                f"replay folded {len(state.jobs)} jobs, expected {JOBS}"
+            )
+        return {
+            "records": report["records"],
+            "segments": report["segments"],
+            "recover_s": round(t1 - t0, 6),
+            "replay_s": round(t2 - t1, 6),
+            "total_s": round(t2 - t0, 6),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main() -> None:
+    points = [bench_one(n) for n in JOURNAL_LENGTHS]
+    biggest = points[-1]
+    print(json.dumps({
+        "metric": "crash_recovery_latency",
+        "points": points,
+        "throughput_rec_per_s": round(
+            biggest["records"] / max(biggest["total_s"], 1e-9)
+        ),
+        "unit": "s",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,7 @@ def orchestrate(
     health_monitor=None,
     recovery_policy="pause-resolve-resume",
     replan_degrade_factor=2.0,
+    resume_dir=None,
 ):
     """Solve the SPASE problem and run the batch to completion.
 
@@ -84,6 +85,7 @@ def orchestrate(
         health_monitor=health_monitor,
         recovery_policy=recovery_policy,
         replan_degrade_factor=replan_degrade_factor,
+        resume_dir=resume_dir,
     )
 
 

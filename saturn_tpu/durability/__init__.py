@@ -1,0 +1,58 @@
+"""Crash-safe durability: write-ahead journal + restart recovery.
+
+PR 2/3 made the *fleet* elastic (slice preemptions replan, preempted jobs
+requeue) but left the orchestrator/service process itself as a single point
+of total state loss: queue, admission outcomes, realized iterations and the
+live plan all lived in memory. On real TPU fleets the controller host is
+preempted as often as the slices are, so this package gives the control
+plane the same treatment the data-system Saturn (arXiv:2309.01226) gives
+re-derivable state — cache what can be recomputed (profiles, compiled
+programs), write-ahead-log what cannot (state transitions):
+
+- :mod:`saturn_tpu.durability.journal` — append-only, CRC-checksummed JSONL
+  write-ahead journal with monotonic sequence numbers, fsync'd group
+  commits and atomic segment rotation. Torn/corrupt trailing records are
+  detected on open, quarantined to ``*.corrupt`` sidecars, and the log is
+  rolled back to the last durable cut.
+- :mod:`saturn_tpu.durability.recovery` — replays the journal into typed
+  recovery state: the online service's job registry (admissions, lifecycle
+  edges, per-job realized iterations, last committed plan) or the batch
+  orchestrator's per-task progress. Published checkpoints are reconciled
+  against disk (corrupt ones quarantined, falling back to the previous
+  publication).
+
+The kill-replay crash harness that drives this under test lives in
+:mod:`saturn_tpu.resilience.crash`; the wiring into the service loop and
+``orchestrate(resume_dir=...)`` is documented in ``docs/architecture.md``
+("Crash recovery & durability").
+"""
+
+from saturn_tpu.durability.journal import (
+    Journal,
+    JournalCorruptError,
+    recover,
+    replay,
+)
+from saturn_tpu.durability.recovery import (
+    BatchRecovery,
+    JobReplay,
+    ServiceRecovery,
+    build_restore_records,
+    reconcile_checkpoints,
+    replay_batch_state,
+    replay_service_state,
+)
+
+__all__ = [
+    "Journal",
+    "JournalCorruptError",
+    "recover",
+    "replay",
+    "BatchRecovery",
+    "JobReplay",
+    "ServiceRecovery",
+    "build_restore_records",
+    "reconcile_checkpoints",
+    "replay_batch_state",
+    "replay_service_state",
+]

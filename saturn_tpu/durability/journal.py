@@ -1,0 +1,404 @@
+"""Append-only, checksummed write-ahead journal (JSONL segments).
+
+Record format — one JSON object per line::
+
+    {"crc": "9a3f01c2", "data": {...}, "kind": "job_state",
+     "seq": 412, "ts": 1754390400.123456}
+
+``seq`` is strictly monotonic across segments AND process incarnations (a
+restarted writer continues from the last durable sequence number), ``crc``
+is the CRC32 of the record serialized without its ``crc`` field (sorted
+keys, compact separators — the exact bytes :func:`_encode` produces, which
+``json.loads``/``json.dumps`` round-trips deterministically). A record that
+fails either check marks the durable cut: everything from that byte offset
+on is a torn tail (the writer died mid-append) or corruption, and
+:func:`recover` quarantines it to a ``*.corrupt`` sidecar instead of
+letting replay raise.
+
+Write path:
+
+- ``append()`` buffers an encoded record (thread-safe: engine launcher
+  threads journal per-task progress while the loop thread owns commits).
+- ``commit()`` is a **group commit**: one ``write`` + one ``fsync`` for
+  every record buffered since the last commit. Anything appended but not
+  yet committed dies with the process — by design, the durability contract
+  is "committed means survives SIGKILL", nothing weaker or stronger.
+- Segments rotate atomically once they pass ``segment_max_bytes``: the new
+  segment is created as a ``.tmp`` with its ``segment_open`` header record
+  already fsync'd, then renamed into place and the directory fsync'd. A
+  crash mid-rotation leaves only a ``.tmp`` (ignored and deleted by
+  recovery) — never a half-initialized live segment.
+
+Crash-harness hook: ``barrier(point, **ctx)`` fires the injected callback
+at every durability-critical edge (``pre-commit``, ``mid-fsync``,
+``post-commit``, ``pre-rotate``, ``post-rename``) plus any caller-defined
+points (the service loop adds ``mid-interval`` / ``post-checkpoint``). The
+kill-replay harness (:mod:`saturn_tpu.resilience.crash`) raises a simulated
+SIGKILL from these callbacks — including tearing the tail of a mid-fsync
+write to model a lost page cache.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("saturn_tpu")
+
+_SEGMENT_PREFIX = "wal-"
+_SEGMENT_SUFFIX = ".jsonl"
+_JSON_OPTS = {"sort_keys": True, "separators": (",", ":"), "default": str}
+
+
+class JournalCorruptError(RuntimeError):
+    """A journal record failed its CRC/sequence check where recovery cannot
+    roll it back (i.e. the caller asked for strict replay)."""
+
+
+def _segment_path(root: str, index: int) -> str:
+    return os.path.join(root, f"{_SEGMENT_PREFIX}{index:06d}{_SEGMENT_SUFFIX}")
+
+
+def _segment_index(name: str) -> Optional[int]:
+    if not (name.startswith(_SEGMENT_PREFIX) and name.endswith(_SEGMENT_SUFFIX)):
+        return None
+    try:
+        return int(name[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)])
+    except ValueError:
+        return None
+
+
+def _crc_of(body: Dict[str, Any]) -> str:
+    return format(
+        zlib.crc32(json.dumps(body, **_JSON_OPTS).encode("utf-8")), "08x"
+    )
+
+
+def _verify_line(line: str, prev_seq: Optional[int]) -> Optional[Dict[str, Any]]:
+    """Parse + checksum + sequence-check one record line; None = corrupt."""
+    try:
+        rec = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        return None
+    if not isinstance(rec, dict) or "crc" not in rec or "seq" not in rec:
+        return None
+    claimed = rec.pop("crc")
+    if _crc_of(rec) != claimed:
+        return None
+    if prev_seq is not None and rec["seq"] != prev_seq + 1:
+        return None  # a gap or repeat means an earlier durable cut was lost
+    return rec
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without directory fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _quarantine_bytes(seg_path: str, offset: int) -> str:
+    """Move everything from ``offset`` on into a ``.corrupt`` sidecar and
+    truncate the live segment back to the durable cut."""
+    sidecar = seg_path + ".corrupt"
+    n = 1
+    while os.path.exists(sidecar):
+        n += 1
+        sidecar = f"{seg_path}.corrupt.{n}"
+    with open(seg_path, "rb") as f:
+        f.seek(offset)
+        bad = f.read()
+    with open(sidecar, "wb") as f:
+        f.write(bad)
+        f.flush()
+        os.fsync(f.fileno())
+    with open(seg_path, "r+b") as f:
+        f.truncate(offset)
+        f.flush()
+        os.fsync(f.fileno())
+    return sidecar
+
+
+def recover(root: str) -> Dict[str, Any]:
+    """Scan the journal directory, quarantine anything past the last durable
+    cut, and report what survived.
+
+    Mutating and idempotent: half-rotated ``.tmp`` segments are deleted,
+    a torn/corrupt tail is moved to ``<segment>.corrupt`` (the live segment
+    is truncated back to the cut), and — because a mid-file corruption
+    invalidates everything after it — whole later segments are quarantined
+    by rename. Returns ``{"segments", "records", "last_seq",
+    "quarantined": [sidecar paths]}``.
+    """
+    report: Dict[str, Any] = {
+        "segments": 0, "records": 0, "last_seq": None, "quarantined": [],
+    }
+    if not os.path.isdir(root):
+        return report
+    names = sorted(os.listdir(root))
+    for name in names:
+        if name.endswith(".tmp"):
+            os.unlink(os.path.join(root, name))  # crashed mid-rotation
+    segments = sorted(
+        (idx, name) for name in names
+        if (idx := _segment_index(name)) is not None
+    )
+    prev_seq: Optional[int] = None
+    cut_found = False
+    for idx, name in segments:
+        seg_path = os.path.join(root, name)
+        if cut_found:
+            # corruption in an earlier segment: everything after the durable
+            # cut rolls back, even structurally-valid later segments
+            sidecar = seg_path + ".corrupt"
+            n = 1
+            while os.path.exists(sidecar):
+                n += 1
+                sidecar = f"{seg_path}.corrupt.{n}"
+            os.replace(seg_path, sidecar)
+            report["quarantined"].append(sidecar)
+            continue
+        report["segments"] += 1
+        with open(seg_path, "rb") as f:
+            raw = f.read()
+        offset = 0
+        while offset < len(raw):
+            nl = raw.find(b"\n", offset)
+            if nl < 0:
+                break  # trailing bytes without a newline: torn append
+            rec = _verify_line(raw[offset:nl].decode("utf-8", "replace"),
+                               prev_seq)
+            if rec is None:
+                break
+            prev_seq = rec["seq"]
+            report["records"] += 1
+            offset = nl + 1
+        if offset < len(raw):
+            sidecar = _quarantine_bytes(seg_path, offset)
+            report["quarantined"].append(sidecar)
+            logger.warning(
+                "journal recovery: quarantined %d torn/corrupt byte(s) of "
+                "%s to %s (rolled back to seq %s)",
+                len(raw) - offset, seg_path, sidecar, prev_seq,
+            )
+            cut_found = True
+    report["last_seq"] = prev_seq
+    return report
+
+
+def replay(root: str, strict: bool = False) -> List[Dict[str, Any]]:
+    """Read every durable record back, in sequence order.
+
+    Non-mutating. With ``strict=False`` (default) replay stops silently at
+    the first bad record — call :func:`recover` first if you want the bad
+    tail quarantined; ``strict=True`` raises :class:`JournalCorruptError`
+    instead (integrity audits, the crash tests' assertions).
+    """
+    out: List[Dict[str, Any]] = []
+    if not os.path.isdir(root):
+        return out
+    segments = sorted(
+        (idx, name) for name in os.listdir(root)
+        if (idx := _segment_index(name)) is not None
+    )
+    prev_seq: Optional[int] = None
+    for _idx, name in segments:
+        seg_path = os.path.join(root, name)
+        with open(seg_path, "rb") as f:
+            raw = f.read()
+        offset = 0
+        while offset < len(raw):
+            nl = raw.find(b"\n", offset)
+            if nl < 0:
+                break
+            line = raw[offset:nl].decode("utf-8", "replace")
+            rec = _verify_line(line, prev_seq)
+            if rec is None:
+                if strict:
+                    raise JournalCorruptError(
+                        f"corrupt journal record in {seg_path} at byte "
+                        f"{offset} (after seq {prev_seq})"
+                    )
+                return out
+            prev_seq = rec["seq"]
+            out.append(rec)
+            offset = nl + 1
+        if offset < len(raw):
+            if strict:
+                raise JournalCorruptError(
+                    f"torn trailing record in {seg_path} at byte {offset}"
+                )
+            return out
+    return out
+
+
+class Journal:
+    """The write-ahead journal: append/commit over rotating segments.
+
+    Opening a journal directory first runs :func:`recover` (quarantining any
+    torn tail), then starts a **fresh segment** whose sequence numbers
+    continue from the last durable record — prior segments are immutable
+    from that point on, so a crashed incarnation can never dirty a healthy
+    one's files.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        segment_max_bytes: int = 4 * 1024 * 1024,
+        barrier: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+        sync: bool = True,
+    ):
+        self.root = root
+        self.segment_max_bytes = segment_max_bytes
+        self.sync = sync
+        self._barrier_cb = barrier
+        self._lock = threading.RLock()
+        self._buf: List[bytes] = []
+        self._closed = False
+        os.makedirs(root, exist_ok=True)
+        self.recovery_report = recover(root)
+        self._seq = self.recovery_report["last_seq"] or 0
+        taken = [
+            idx for name in os.listdir(root)
+            if (idx := _segment_index(name.split(".corrupt")[0])) is not None
+        ]
+        self._segment_index = (max(taken) + 1) if taken else 1
+        self._fh = None
+        self._path = ""
+        self._size = 0
+        self._open_segment()
+
+    # ------------------------------------------------------------- barriers
+    def barrier(self, point: str, **ctx) -> None:
+        """Cross a named durability barrier; the crash harness hooks here."""
+        cb = self._barrier_cb
+        if cb is not None:
+            cb(point, ctx)
+
+    # -------------------------------------------------------------- segments
+    def _encode(self, kind: str, data: Dict[str, Any]) -> bytes:
+        self._seq += 1
+        body = {
+            "seq": self._seq,
+            "ts": round(time.time(), 6),
+            "kind": kind,
+            "data": data,
+        }
+        rec = dict(body, crc=_crc_of(body))
+        return (json.dumps(rec, **_JSON_OPTS) + "\n").encode("utf-8")
+
+    def _open_segment(self) -> None:
+        path = _segment_path(self.root, self._segment_index)
+        tmp = path + ".tmp"
+        header = self._encode(
+            "segment_open",
+            {"segment": self._segment_index, "pid": os.getpid()},
+        )
+        with open(tmp, "wb") as f:
+            f.write(header)
+            f.flush()
+            if self.sync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic rotation: never a half-written segment
+        if self.sync:
+            _fsync_dir(self.root)
+        self._path = path
+        self._fh = open(path, "ab")
+        self._size = os.path.getsize(path)
+        self.barrier("post-rename", path=path, segment=self._segment_index)
+
+    def _rotate(self) -> None:
+        self.barrier("pre-rotate", path=self._path)
+        self._fh.flush()
+        if self.sync:
+            os.fsync(self._fh.fileno())
+        self._fh.close()
+        self._segment_index += 1
+        self._open_segment()
+
+    # --------------------------------------------------------------- writes
+    def append(self, kind: str, **data) -> int:
+        """Buffer one record; returns its sequence number. NOT durable until
+        the next :meth:`commit` — callers choose the group-commit cadence."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("journal is closed")
+            line = self._encode(kind, data)
+            self._buf.append(line)
+            return self._seq
+
+    def log(self, kind: str, **data) -> int:
+        """``append`` + immediate ``commit`` — for records that must be
+        durable before the caller returns (e.g. a client-acknowledged job
+        submission)."""
+        with self._lock:
+            seq = self.append(kind, **data)
+            self.commit()
+            return seq
+
+    def commit(self) -> int:
+        """Group-commit every buffered record: one write, one fsync.
+        Returns the number of records made durable."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("journal is closed")
+            if not self._buf:
+                return 0
+            self.barrier("pre-commit", path=self._path, pending=len(self._buf))
+            payload = b"".join(self._buf)
+            n = len(self._buf)
+            self._buf.clear()
+            start = self._size
+            self._fh.write(payload)
+            self._fh.flush()
+            # Between flush and fsync the bytes live in the page cache: a
+            # power cut here is exactly the torn-tail case recovery handles.
+            self.barrier(
+                "mid-fsync", path=self._path, start=start,
+                end=start + len(payload),
+            )
+            if self.sync:
+                os.fsync(self._fh.fileno())
+            self._size += len(payload)
+            self.barrier("post-commit", path=self._path, seq=self._seq)
+            if self._size >= self.segment_max_bytes:
+                self._rotate()
+            return n
+
+    @property
+    def last_seq(self) -> int:
+        return self._seq
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def close(self) -> None:
+        """Commit anything buffered, fsync, close. NOT called on a simulated
+        kill — a dead process flushes nothing."""
+        with self._lock:
+            if self._closed:
+                return
+            self.commit()
+            self._closed = True
+            self._fh.flush()
+            if self.sync:
+                os.fsync(self._fh.fileno())
+            self._fh.close()
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,304 @@
+"""Journal replay → typed recovery state + checkpoint reconciliation.
+
+Two consumers replay the same write-ahead journal:
+
+- the online service (``SaturnService(durability_dir=...)``) rebuilds its
+  job registry: every job ever submitted, its last durable lifecycle state,
+  retry/requeue accounting, per-job realized iterations, and the last
+  committed plan (which warm-starts the first post-restart re-solve);
+- the batch orchestrator (``orchestrate(resume_dir=...)``) rebuilds
+  per-task progress so a restarted batch only runs the iterations that were
+  never durably recorded.
+
+The recovery state machine is intentionally conservative: only **committed**
+journal records count (recovery runs after :func:`journal.recover` has
+rolled torn tails back to the last durable cut), so iterations executed but
+not yet committed are re-run — re-running work is safe, double-counting it
+is not. See ``docs/architecture.md`` ("Crash recovery & durability") for
+the full record schema and operator runbook.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from saturn_tpu.durability import journal as jmod
+
+logger = logging.getLogger("saturn_tpu")
+
+#: Lifecycle states that need no resurrection on restart.
+_TERMINAL = frozenset({"DONE", "FAILED", "EVICTED"})
+
+
+@dataclass
+class JobReplay:
+    """One job's reconstructed durable state."""
+
+    job_id: str
+    task: str
+    priority: float = 0.0
+    deadline_s: Optional[float] = None
+    max_retries: int = 1
+    total_batches: int = 0         # as submitted (the original budget)
+    realized: int = 0              # durably journaled completed iterations
+    state: str = "QUEUED"
+    attempts: int = 0
+    requeues: int = 0
+    error: Optional[str] = None
+    spec: Optional[dict] = None    # caller-supplied rebuild spec
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in _TERMINAL
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.total_batches - self.realized)
+
+
+@dataclass
+class ServiceRecovery:
+    """Everything the service needs to resume from the durable cut."""
+
+    jobs: Dict[str, JobReplay] = field(default_factory=dict)
+    plan: Optional[dict] = None          # last committed plan (to_json form)
+    checkpoints: Dict[str, List[str]] = field(default_factory=dict)
+    last_seq: int = 0
+    n_records: int = 0
+    incarnations: int = 0
+
+    def live_jobs(self) -> List[JobReplay]:
+        return [j for j in self.jobs.values() if not j.terminal]
+
+
+@dataclass
+class BatchRecovery:
+    """Per-task durable progress for ``orchestrate(resume_dir=...)``."""
+
+    progress: Dict[str, int] = field(default_factory=dict)
+    completed: List[str] = field(default_factory=list)
+    failed: Dict[str, str] = field(default_factory=dict)
+    plan: Optional[dict] = None
+    checkpoints: Dict[str, List[str]] = field(default_factory=dict)
+    last_seq: int = 0
+    n_records: int = 0
+
+
+def replay_service_state(root: str) -> ServiceRecovery:
+    """Fold the durable journal into the service's recovery state.
+
+    Pure read — call :func:`journal.recover` first so torn tails are
+    already rolled back. Handles multi-incarnation journals: a job
+    submitted in incarnation 1, recovered in 2 and finished in 3 folds into
+    one :class:`JobReplay` keyed by its stable ``job_id``.
+    """
+    state = ServiceRecovery()
+    for rec in jmod.replay(root):
+        kind, d = rec["kind"], rec.get("data", {})
+        state.n_records += 1
+        state.last_seq = rec["seq"]
+        if kind == "segment_open":
+            continue
+        if kind == "recovery":
+            state.incarnations += 1
+        elif kind == "job_submitted":
+            state.jobs[d["job"]] = JobReplay(
+                job_id=d["job"],
+                task=d["task"],
+                priority=float(d.get("priority", 0.0)),
+                deadline_s=d.get("deadline_s"),
+                max_retries=int(d.get("max_retries", 1)),
+                total_batches=int(d.get("total_batches") or 0),
+                spec=d.get("spec"),
+            )
+        elif kind == "job_recovered":
+            j = state.jobs.get(d["job"])
+            if j is not None:
+                j.state = "QUEUED"
+                j.requeues = int(d.get("requeues", j.requeues))
+        elif kind == "job_state":
+            j = state.jobs.get(d["job"])
+            if j is not None:
+                j.state = d["state"]
+                j.attempts = int(d.get("attempts", j.attempts))
+                j.requeues = int(d.get("requeues", j.requeues))
+                if d.get("error") is not None:
+                    j.error = d["error"]
+        elif kind == "task_progress":
+            j = state.jobs.get(d.get("job", ""))
+            if j is not None:
+                j.realized += int(d.get("batches", 0))
+        elif kind == "plan_commit":
+            if d.get("plan") is not None:
+                state.plan = d["plan"]
+        elif kind == "ckpt_published":
+            task = d.get("task") or d.get("path", "")
+            state.checkpoints.setdefault(task, []).append(d.get("path", ""))
+    return state
+
+
+def replay_batch_state(root: str) -> BatchRecovery:
+    """Fold the journal into the batch orchestrator's per-task progress."""
+    state = BatchRecovery()
+    for rec in jmod.replay(root):
+        kind, d = rec["kind"], rec.get("data", {})
+        state.n_records += 1
+        state.last_seq = rec["seq"]
+        if kind == "task_progress":
+            name = d.get("task", "")
+            state.progress[name] = state.progress.get(name, 0) + int(
+                d.get("batches", 0)
+            )
+        elif kind == "task_completed":
+            if d["task"] not in state.completed:
+                state.completed.append(d["task"])
+        elif kind == "task_failed":
+            state.failed[d["task"]] = d.get("error", "journaled failure")
+        elif kind == "plan_commit":
+            if d.get("plan") is not None:
+                state.plan = d["plan"]
+        elif kind == "ckpt_published":
+            task = d.get("task") or d.get("path", "")
+            state.checkpoints.setdefault(task, []).append(d.get("path", ""))
+    return state
+
+
+def reconcile_checkpoints(
+    checkpoints: Dict[str, List[str]],
+) -> Dict[str, Optional[str]]:
+    """Verify journaled checkpoint publications against the disk.
+
+    For each task, walk its publications newest-first: a checkpoint that is
+    missing is skipped, one that fails its archive checksum
+    (``checkpoint.verify``) is quarantined to ``*.corrupt``, and the newest
+    *valid* one wins — recovery falls back to the previous durable
+    publication rather than dying on a torn write. Returns
+    ``{task: authoritative path or None}``.
+    """
+    import os
+
+    from saturn_tpu.utils import checkpoint as ckpt
+
+    out: Dict[str, Optional[str]] = {}
+    for task, paths in checkpoints.items():
+        out[task] = None
+        for path in reversed(paths):
+            if not os.path.exists(path):
+                continue
+            if ckpt.verify(path):
+                out[task] = path
+                break
+            quarantined = ckpt.quarantine(path)
+            logger.warning(
+                "recovery: checkpoint %s for %s failed verification — "
+                "quarantined to %s, falling back to the previous "
+                "publication", path, task, quarantined,
+            )
+    return out
+
+
+class RecoveredTaskStub:
+    """Placeholder task for a journaled job that needs no execution (it is
+    already terminal) — keeps the queue registry's duck-typed contract
+    (``.name`` / ``.total_batches``) without a rebuildable model closure."""
+
+    def __init__(self, name: str, total_batches: int = 0):
+        self.name = name
+        self.total_batches = total_batches
+        self.strategies: Dict[int, Any] = {}
+
+    def feasible_strategies(self) -> Dict[int, Any]:
+        return {}
+
+
+def build_restore_records(
+    state: ServiceRecovery,
+    task_provider: Optional[Callable[[dict], Any]],
+) -> List:
+    """Turn replayed jobs into queue-restorable :class:`JobRecord`s.
+
+    Live (non-terminal) jobs are resurrected through ``task_provider``,
+    which receives the job's durable spec (including ``remaining_batches``,
+    the original budget minus durably journaled iterations) and returns a
+    fresh task object; the record re-enters the queue as QUEUED and
+    re-admits warm through the profile cache (zero trials for a previously
+    profiled fingerprint). Terminal jobs are restored as inert registry
+    entries so ``status``/``wait`` keep answering and their names stay
+    released for reuse. Raises if live jobs exist but no provider does —
+    silently dropping admitted work is the exact failure this package
+    exists to prevent.
+    """
+    import time
+
+    from saturn_tpu.service.queue import JobRecord, JobRequest, JobState
+
+    live = state.live_jobs()
+    if live and task_provider is None:
+        raise RuntimeError(
+            f"journal holds {len(live)} live job(s) "
+            f"({', '.join(j.job_id for j in live)}) but no task_provider was "
+            "given — pass SaturnService(task_provider=...) so recovery can "
+            "rebuild their task objects"
+        )
+    out: List = []
+    now = time.monotonic()
+    for j in state.jobs.values():
+        # A live job whose every iteration is durably journaled already
+        # finished — only the terminal verdict died with the crash. Restore
+        # it DONE instead of re-queueing a zero-batch task (the caller
+        # re-journals the verdict so the next incarnation replays it
+        # directly).
+        finished = (
+            not j.terminal and j.total_batches > 0
+            and j.realized >= j.total_batches
+        )
+        if j.terminal or finished:
+            req = JobRequest(
+                task=RecoveredTaskStub(j.task, j.total_batches),
+                priority=j.priority, deadline_s=j.deadline_s,
+                max_retries=j.max_retries, spec=j.spec,
+            )
+            rec = JobRecord(
+                job_id=j.job_id, request=req,
+                state=JobState.DONE if finished else JobState(j.state),
+                submitted_at=now, finished_at=now, attempts=j.attempts,
+                requeues=j.requeues, error=j.error,
+            )
+            out.append(rec)
+            continue
+        task = task_provider({
+            "job_id": j.job_id,
+            "task": j.task,
+            "total_batches": j.total_batches,
+            "remaining_batches": j.remaining,
+            "priority": j.priority,
+            "deadline_s": j.deadline_s,
+            "max_retries": j.max_retries,
+            "spec": j.spec,
+        })
+        if getattr(task, "name", None) != j.task:
+            raise ValueError(
+                f"task_provider returned task named "
+                f"{getattr(task, 'name', None)!r} for journaled job "
+                f"{j.job_id} ({j.task!r}) — names must match"
+            )
+        # The journal is authoritative for progress: durably completed
+        # iterations are never re-run.
+        task.total_batches = j.remaining
+        req = JobRequest(
+            task=task, priority=j.priority, deadline_s=j.deadline_s,
+            max_retries=j.max_retries, spec=j.spec,
+        )
+        rec = JobRecord(
+            job_id=j.job_id, request=req, state=JobState.QUEUED,
+            submitted_at=now,
+            deadline_at=(now + j.deadline_s
+                         if j.deadline_s is not None else None),
+            attempts=j.attempts,
+            requeues=j.requeues + (1 if j.state in ("RUNNING", "SCHEDULED")
+                                   else 0),
+        )
+        out.append(rec)
+    return out

@@ -152,6 +152,7 @@ def execute(
     faults=None,
     interval_index: int = 0,
     on_task_start=None,
+    on_task_done=None,
 ) -> Dict[str, BaseException]:
     """Gang-execute one interval (reference ``executor.py:88-129``).
 
@@ -182,6 +183,13 @@ def execute(
     from its launcher thread once dependencies and the preemption gate have
     cleared, immediately before the technique runs. The online job service
     uses it to mark jobs RUNNING at the true launch instant.
+
+    ``on_task_done`` (single-host only): callback ``(name, n_batches)``
+    invoked from the launcher thread only after the task's interval fully
+    succeeded — technique executed, mid-run preemption gate cleared, data
+    cursor advanced. The durability layer journals realized iterations from
+    here: a batch count passed to ``on_task_done`` really ran, so a failed
+    or preempted attempt never reaches the ledger.
     """
     from saturn_tpu.core import distributed
 
@@ -245,6 +253,8 @@ def execute(
             task.reconfigure(n)  # data-cursor advance (``executor.py:84``)
             if didx:
                 health.note_step(didx, dt_run / max(n, 1))
+            if on_task_done is not None:
+                on_task_done(task.name, n)
         except BaseException as e:  # surface after the barrier
             errors[task.name] = e
             if isinstance(e, PreemptedError):

@@ -42,6 +42,7 @@ def orchestrate(
     health_monitor=None,
     recovery_policy: str = "pause-resolve-resume",
     replan_degrade_factor: float = 2.0,
+    resume_dir: Optional[str] = None,
 ) -> dict:
     """Run every task to completion, minimizing batch makespan.
 
@@ -65,6 +66,15 @@ def orchestrate(
     aborts-and-requeues the affected tasks (``PreemptedError`` — requeued
     WITHOUT counting against ``max_task_retries``); migrated tasks resume
     from their checkpoints on the new mesh. Single-host only.
+
+    Durability (``saturn_tpu.durability``): ``resume_dir`` points the run at
+    a write-ahead journal directory. Every interval's realized iterations,
+    plan commits, completions/failures and checkpoint publications are
+    group-committed there; re-running ``orchestrate(resume_dir=...)`` after
+    a crash replays the journal (torn trailing records are quarantined and
+    rolled back to the last durable cut), drops journaled-completed tasks,
+    subtracts durably realized batches from each survivor's budget, and
+    resumes — no durably completed iteration re-runs. Single-host only.
 
     Returns ``{"completed": [names], "failed": {name: error string}}``.
     """
@@ -128,18 +138,51 @@ def orchestrate(
     all_completed: List[str] = []
     all_failed: dict = {}
     retries: dict = {}  # task name -> failed attempts so far
+
+    journal = None
+    ckpt_hook = None
+    if resume_dir is not None:
+        if distributed.is_multihost():
+            raise ValueError(
+                "resume_dir (crash-safe durability) is single-host only — "
+                "multi-controller journal consensus is future work"
+            )
+        from saturn_tpu.durability import journal as jmod
+        from saturn_tpu.durability import recovery as rmod
+        from saturn_tpu.utils import checkpoint as _ckpt
+
+        journal = jmod.Journal(resume_dir)  # recovers torn tails on open
+        state = rmod.replay_batch_state(resume_dir)
+        if state.checkpoints:
+            rmod.reconcile_checkpoints(state.checkpoints)
+        task_list = _fold_batch_recovery(
+            task_list, state, all_completed, all_failed
+        )
+        journal.log(
+            "recovery", replayed_seq=state.last_seq,
+            replayed_records=state.n_records,
+            completed=len(all_completed), remaining=len(task_list),
+        )
+
+        def ckpt_hook(task_name, path):
+            journal.append("ckpt_published", task=task_name, path=path)
+
+        _ckpt.add_publish_hook(ckpt_hook)
+
     try:
         return _orchestrate_loop(
             task_list, topo, interval, threshold, tlimit, failure_policy,
             max_task_retries, metrics_path, trace_dir,
             all_completed, all_failed, retries,
-            health_monitor, fault_injector, replanner,
+            health_monitor, fault_injector, replanner, journal,
         )
     finally:
         import sys
 
         from saturn_tpu.utils import checkpoint as ckpt
 
+        if ckpt_hook is not None:
+            ckpt.remove_publish_hook(ckpt_hook)
         try:
             # join outstanding async checkpoint writes on EVERY exit path —
             # a caller catching a failure must still see landed checkpoints
@@ -150,6 +193,49 @@ def orchestrate(
             logger.exception(
                 "async checkpoint flush failed during error unwind"
             )
+        if journal is not None:
+            # Buffered records describe work that really happened
+            # (task_progress only fires post-success), so committing them on
+            # an error unwind is correct; a hard crash skips this and loses
+            # only re-runnable work.
+            try:
+                journal.close()
+            except Exception:
+                logger.exception("journal close failed during unwind")
+
+
+def _fold_batch_recovery(task_list, state, all_completed, all_failed) -> List:
+    """Apply replayed journal state to a fresh task list: journaled-terminal
+    tasks never re-run, and durably realized batches come off each
+    survivor's budget (strategy runtimes re-derived from per-batch
+    profiles). The journal is authoritative — it only records iterations
+    that actually executed."""
+    out = []
+    for t in task_list:
+        if t.name in state.completed:
+            all_completed.append(t.name)
+            logger.info("resume: %s already completed durably — skipping",
+                        t.name)
+            continue
+        if t.name in state.failed:
+            all_failed[t.name] = state.failed[t.name]
+            logger.info("resume: %s failed durably — not retrying", t.name)
+            continue
+        realized = state.progress.get(t.name, 0)
+        if realized > 0:
+            t.total_batches = max(0, t.total_batches - realized)
+            for s in t.strategies.values():
+                if s.feasible:
+                    s.runtime = s.per_batch_time * t.total_batches
+            logger.info(
+                "resume: %s has %d durably realized batch(es) — %d remain",
+                t.name, realized, t.total_batches,
+            )
+            if t.total_batches <= 0:
+                all_completed.append(t.name)
+                continue
+        out.append(t)
+    return out
 
 
 def _persist_realized(task) -> None:
@@ -253,7 +339,7 @@ def _orchestrate_loop(
     task_list, topo, interval, threshold, tlimit, failure_policy,
     max_task_retries, metrics_path, trace_dir,
     all_completed, all_failed, retries,
-    health=None, faults=None, replanner=None,
+    health=None, faults=None, replanner=None, journal=None,
 ) -> dict:
     from saturn_tpu.core import distributed
     from saturn_tpu.resilience.faults import PreemptedError
@@ -264,6 +350,12 @@ def _orchestrate_loop(
         # on shared storage would duplicate each event N-fold (and NFS
         # O_APPEND interleaving is not line-atomic).
         metrics_path = None
+    if not task_list:
+        # Nothing left to run — e.g. a resumed batch whose journal already
+        # records every task terminal (restart after a crash-after-finish).
+        logger.info("orchestration complete (%d completed, %d failed)",
+                    len(all_completed), len(all_failed))
+        return {"completed": all_completed, "failed": all_failed}
     with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
         if multihost:
             # Profile sync BEFORE the first forecast: per-process wall-clock
@@ -286,6 +378,16 @@ def _orchestrate_loop(
             )
         logger.info("initial plan: makespan %.1fs, %d tasks", plan.makespan, len(task_list))
         metrics.event("solve", makespan_s=plan.makespan, n_tasks=len(task_list))
+        if journal is not None:
+            journal.append("plan_commit", interval=0,
+                           makespan=plan.makespan, plan=plan.to_json())
+
+        on_done = None
+        if journal is not None:
+            def on_done(name, n):  # buffered; durable at interval end
+                if n > 0:
+                    journal.append("task_progress", task=name,
+                                   batches=int(n))
 
         base_topo = topo  # health-monitor indices refer to the pre-fault fleet
         interval_index = 0
@@ -336,7 +438,11 @@ def _orchestrate_loop(
                         failure_policy="raise" if failure_policy == "raise" else "drop",
                         health=health, faults=faults,
                         interval_index=interval_index,
+                        on_task_done=on_done,
                     )
+                    if journal is not None:
+                        journal.barrier("mid-interval",
+                                        interval=interval_index)
                 elif remaining:
                     # nothing scheduled inside this interval (all starts beyond
                     # it): the slide in resolve() brings work forward next round.
@@ -381,6 +487,11 @@ def _orchestrate_loop(
                     logger.info("re-solve: makespan %.1fs", plan.makespan)
                     metrics.event("solve", makespan_s=plan.makespan,
                                   n_tasks=len(remaining))
+                    if journal is not None:
+                        journal.append("plan_commit",
+                                       interval=interval_index + 1,
+                                       makespan=plan.makespan,
+                                       plan=plan.to_json())
 
                 # Estimate feedback: fold each task's realized per-batch time
                 # into its executed strategy (EWMA) now that no solver thread
@@ -480,6 +591,9 @@ def _orchestrate_loop(
                             )
                         else:
                             all_failed[name] = repr(err)
+                            if journal is not None:
+                                journal.append("task_failed", task=name,
+                                               error=repr(err))
                             metrics.event("task_failed", task=name, error=repr(err))
                             logger.warning("evicting failed task %s: %r", name, err)
                             # permanently dropped: also free its compiled
@@ -500,6 +614,8 @@ def _orchestrate_loop(
 
                 for t in completed:
                     all_completed.append(t.name)
+                    if journal is not None:
+                        journal.append("task_completed", task=t.name)
                     metrics.event("task_completed", task=t.name)
                     release = getattr(t, "release_live_state", None)
                     if release is not None:
@@ -508,6 +624,12 @@ def _orchestrate_loop(
                     if release_c is not None:
                         release_c()  # and their compiled programs
                 task_list = remaining
+                if journal is not None:
+                    # Interval-end group commit: one fsync covers this
+                    # interval's progress, plan and completion records.
+                    journal.append("interval_commit",
+                                   interval=interval_index)
+                    journal.commit()
                 interval_index += 1
     logger.info("orchestration complete (%d completed, %d failed)",
                 len(all_completed), len(all_failed))

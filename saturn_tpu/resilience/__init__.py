@@ -22,6 +22,12 @@ different shape than it was saved under) lives in
 ``jax.device_put`` against the new sharding spec.
 """
 
+from saturn_tpu.resilience.crash import (
+    KILL_POINTS,
+    CrashInjector,
+    SimulatedKill,
+    run_to_kill,
+)
 from saturn_tpu.resilience.faults import (
     FaultEvent,
     FaultInjector,
@@ -33,6 +39,10 @@ from saturn_tpu.resilience.health import DeviceHealth, FleetHealthMonitor, Topol
 from saturn_tpu.resilience.replan import RECOVERY_POLICIES, ElasticReplanner
 
 __all__ = [
+    "KILL_POINTS",
+    "CrashInjector",
+    "SimulatedKill",
+    "run_to_kill",
     "FaultEvent",
     "FaultInjector",
     "FaultKind",

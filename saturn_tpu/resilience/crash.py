@@ -1,0 +1,162 @@
+"""Kill-replay crash harness: deterministic simulated SIGKILLs at journal
+barriers.
+
+PR 2's :mod:`~saturn_tpu.resilience.faults` injects *fleet* failures (slice
+preemptions, stragglers); this module injects *controller* death. A real
+SIGKILL gives the process no chance to flush buffers, run handlers or close
+files — the simulation honors that contract exactly:
+
+- :class:`SimulatedKill` derives from ``BaseException`` so no ordinary
+  ``except Exception`` cleanup path can intercept it; the service loop
+  treats it as process death (no job fail-out, no journal flush, no
+  graceful drain — memory state simply stops existing).
+- The kill fires at a named durability **barrier** (see
+  ``durability.journal.Journal.barrier``): ``pre-commit`` (buffered records
+  die unwritten), ``mid-fsync`` (bytes written but not fsync'd — the
+  injector *tears the tail of the write* to model the lost page cache, so
+  recovery genuinely exercises the torn-record quarantine), ``post-commit``
+  (durable cut advanced, everything after dies), ``pre-rotate`` /
+  ``post-rename`` (segment-rotation edges), plus the service loop's own
+  ``mid-interval`` (work executed, progress not yet durable) and
+  ``post-checkpoint`` (progress + checkpoint publication both durable).
+- Kill-points are deterministic: ``CrashInjector("mid-fsync", hit=2)``
+  fires on exactly the second armed crossing of that barrier;
+  :meth:`CrashInjector.seeded` derives (point, hit) from a seed for chaos
+  sweeps that never flake.
+
+The restart-and-assert half lives in ``tests/test_crash.py``: run a service
+against a durability dir, kill it, build a fresh service on the same dir,
+and assert no admitted job is lost, no durably completed iteration re-runs
+(journal sequence numbers are the evidence), and corrupt trailing artifacts
+are quarantined rather than fatal.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from typing import Dict, Optional, Sequence
+
+logger = logging.getLogger("saturn_tpu")
+
+#: Every barrier a kill can target. The first five are crossed inside
+#: ``Journal.commit``/rotation; the last two are service-loop cuts.
+KILL_POINTS = (
+    "pre-commit",
+    "mid-fsync",
+    "post-commit",
+    "pre-rotate",
+    "post-rename",
+    "mid-interval",
+    "post-checkpoint",
+)
+
+
+class SimulatedKill(BaseException):
+    """The process 'died' at a durability barrier. BaseException on purpose:
+    SIGKILL runs no handlers, so no ``except Exception`` may catch this."""
+
+
+class CrashInjector:
+    """Raises one :class:`SimulatedKill` at the Nth armed crossing of a
+    barrier. Pass ``barrier`` as the journal's callback::
+
+        inj = CrashInjector("mid-fsync", hit=2, armed=False)
+        svc = SaturnService(..., durability_dir=d, crash_barrier=inj.barrier)
+        ...submit work...
+        inj.arm()
+        assert inj.fired.wait(timeout=30)
+
+    ``armed=False`` lets a test finish its setup (submissions commit through
+    the same barriers) before the kill window opens. After firing once the
+    injector is inert — the process is dead; later crossings (e.g. from a
+    launcher thread still unwinding) pass through.
+    """
+
+    def __init__(self, point: str, hit: int = 1, armed: bool = True,
+                 tear_bytes: int = 7):
+        if point not in KILL_POINTS:
+            raise ValueError(
+                f"unknown kill-point {point!r}; use one of {KILL_POINTS}"
+            )
+        if hit < 1:
+            raise ValueError("hit is 1-based")
+        self.point = point
+        self.hit = hit
+        self.tear_bytes = tear_bytes
+        self.fired = threading.Event()
+        self._armed = threading.Event()
+        if armed:
+            self._armed.set()
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    @classmethod
+    def seeded(cls, seed: int, max_hit: int = 3,
+               points: Sequence[str] = KILL_POINTS, **kw) -> "CrashInjector":
+        """Deterministic (point, hit) choice from a seed — the chaos-sweep
+        constructor: same seed, same kill, every run."""
+        rng = random.Random(seed)
+        return cls(rng.choice(list(points)), hit=rng.randint(1, max_hit), **kw)
+
+    def arm(self) -> None:
+        self._armed.set()
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def barrier(self, point: str, ctx: Dict) -> None:
+        """Journal/service barrier callback. Counts armed crossings; on the
+        configured one, optionally tears the in-flight write, then raises."""
+        if self.fired.is_set() or not self._armed.is_set():
+            return
+        with self._lock:
+            if self.fired.is_set():
+                return
+            self._counts[point] = self._counts.get(point, 0) + 1
+            if point != self.point or self._counts[point] != self.hit:
+                return
+            if point == "mid-fsync":
+                self._tear(ctx)
+            self.fired.set()
+        logger.warning(
+            "crash harness: simulated SIGKILL at %s (hit %d)",
+            point, self.hit,
+        )
+        raise SimulatedKill(f"simulated SIGKILL at {point} (hit {self.hit})")
+
+    def _tear(self, ctx: Dict) -> None:
+        """Model the page cache losing the un-fsync'd tail: truncate the
+        just-written bytes mid-record, leaving a genuinely torn trailing
+        line for recovery to quarantine."""
+        path, start, end = ctx.get("path"), ctx.get("start"), ctx.get("end")
+        if not path or start is None or end is None:
+            return
+        cut = max(start, end - self.tear_bytes)
+        if cut >= end:  # nothing written this commit: whole batch vanishes
+            cut = start
+        try:
+            os.truncate(path, cut)
+        except OSError:
+            logger.exception("crash harness: tear of %s failed", path)
+
+
+def run_to_kill(injector: CrashInjector, service, timeout: float = 60.0) -> None:
+    """Arm the injector, wait for the kill to land, and join the dead
+    service loop thread. Raises ``TimeoutError`` if the kill never fires —
+    a harness misconfiguration (wrong point/hit), not a product failure."""
+    injector.arm()
+    if not injector.fired.wait(timeout):
+        raise TimeoutError(
+            f"kill at {injector.point!r} (hit {injector.hit}) never fired "
+            f"within {timeout}s; barrier crossings so far: "
+            f"{injector.counts()}"
+        )
+    thread = getattr(service, "_thread", None)
+    if thread is not None:
+        thread.join(timeout)
+        if thread.is_alive():
+            raise TimeoutError("service loop thread outlived its kill")

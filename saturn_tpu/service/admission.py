@@ -83,6 +83,10 @@ class AdmissionController:
         self.prune = prune
         self.parallel_trials = parallel_trials
         self.queue = queue
+        #: Optional write-ahead journal (set by ``SaturnService`` when
+        #: durability is on): every admission outcome becomes a buffered
+        #: ``job_admission`` record, durable at the next group commit.
+        self.journal = None
 
     def admit(self, rec: JobRecord, topology: SliceTopology) -> AdmissionDecision:
         """Profile (if needed) and decide one arrival.
@@ -182,6 +186,12 @@ class AdmissionController:
         return dec
 
     def _note(self, rec: JobRecord, dec: AdmissionDecision) -> None:
+        if self.journal is not None:
+            self.journal.append(
+                "job_admission", job=rec.job_id, task=rec.name,
+                decision=dec.action, reason=dec.reason,
+                trials_run=dec.trials_run, weight=round(dec.weight, 6),
+            )
         metrics.event(
             "job_admitted", job=rec.job_id, task=rec.name,
             decision=dec.action, reason=dec.reason,

@@ -29,11 +29,19 @@ class ServiceClient:
 
     def submit(self, task, priority: float = 0.0,
                deadline_s: Optional[float] = None,
-               max_retries: int = 1) -> str:
-        """Enqueue a task; returns the job id."""
+               max_retries: int = 1,
+               spec: Optional[dict] = None) -> str:
+        """Enqueue a task; returns the job id.
+
+        ``spec`` is an optional JSON-serializable rebuild payload: when the
+        service runs with ``durability_dir``, it is journaled with the
+        submission and handed back to ``task_provider(spec)`` after a crash
+        so the task object can be reconstructed. On a durable service,
+        ``submit`` returning means the submission survived — it was fsync'd
+        to the write-ahead journal before this call unblocked."""
         rec = self._service.queue.submit(JobRequest(
             task=task, priority=priority, deadline_s=deadline_s,
-            max_retries=max_retries,
+            max_retries=max_retries, spec=spec,
         ))
         return rec.job_id
 

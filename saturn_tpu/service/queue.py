@@ -75,6 +75,11 @@ class JobRequest:
     #                                     pressure sheds work to protect it
     max_retries: int = 1               # extra attempts after a task failure
     #                                    (preemptions never consume these)
+    spec: Optional[dict] = None        # caller-supplied, JSON-serializable
+    #                                    rebuild payload: journaled with the
+    #                                    submission and handed back to
+    #                                    ``task_provider`` on crash recovery
+    #                                    so the task object can be rebuilt
 
 
 @dataclass
@@ -135,12 +140,21 @@ class SubmissionQueue:
     server loop, or an engine launcher thread firing ``on_task_start``).
     """
 
-    def __init__(self):
+    def __init__(self, observer=None):
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._jobs: Dict[str, JobRecord] = {}
         self._arrivals: List[str] = []   # job_ids waiting for the next drain
         self._seq = 0
+        #: Optional ``observer(event, rec, **fields)`` called under the queue
+        #: lock after every registry mutation ("submitted" / "state" /
+        #: "recovered") — the durability layer's write-ahead hook. Lock
+        #: ordering is queue-lock → journal-lock, never the reverse.
+        self.observer = observer
+
+    def _notify_observer(self, event: str, rec: JobRecord, **fields) -> None:
+        if self.observer is not None:
+            self.observer(event, rec, **fields)
 
     # ------------------------------------------------------------ submission
     def submit(self, request: JobRequest) -> JobRecord:
@@ -175,11 +189,53 @@ class SubmissionQueue:
             )
             self._jobs[rec.job_id] = rec
             self._arrivals.append(rec.job_id)
+            self._notify_observer("submitted", rec)
             self._cond.notify_all()
         metrics.event(
             "job_submitted", job=rec.job_id, task=name,
             priority=request.priority, deadline_s=request.deadline_s,
         )
+        return rec
+
+    def restore(self, rec: JobRecord) -> JobRecord:
+        """Re-register a journal-reconstructed job under its *original*
+        ``job_id`` (crash recovery only — new work goes through
+        :meth:`submit`).
+
+        Terminal jobs become inert registry entries so ``status``/``wait``
+        keep answering for them; live jobs also re-enter the arrival queue
+        and re-admit warm. ``_seq`` advances past every recovered id so a
+        post-restart submission can never collide with a journaled one.
+        """
+        name = rec.name
+        with self._lock:
+            if rec.job_id in self._jobs:
+                raise ValueError(f"job id {rec.job_id!r} already registered")
+            if rec.state not in TERMINAL_STATES:
+                for other in self._jobs.values():
+                    if other.name == name and other.state not in TERMINAL_STATES:
+                        raise ValueError(
+                            f"task name {name!r} is already live as "
+                            f"{other.job_id} ({other.state.value}) — cannot "
+                            f"restore {rec.job_id}"
+                        )
+            try:  # job_id format: j{seq:04d}-{name}
+                recovered_seq = int(rec.job_id[1:].split("-", 1)[0])
+            except (ValueError, IndexError):
+                recovered_seq = 0
+            self._seq = max(self._seq, recovered_seq)
+            self._jobs[rec.job_id] = rec
+            if rec.state not in TERMINAL_STATES:
+                if rec.job_id not in self._arrivals:
+                    self._arrivals.append(rec.job_id)
+                self._notify_observer("recovered", rec)
+            self._cond.notify_all()
+        if rec.state not in TERMINAL_STATES:
+            metrics.event(
+                "job_recovered", job=rec.job_id, task=name,
+                requeues=rec.requeues, attempts=rec.attempts,
+                remaining_batches=getattr(rec.task, "total_batches", None),
+            )
         return rec
 
     def requeue(self, rec: JobRecord) -> None:
@@ -233,6 +289,7 @@ class SubmissionQueue:
                 rec.finished_at = now
             if error is not None:
                 rec.error = error
+            self._notify_observer("state", rec)
             self._cond.notify_all()
 
     # -------------------------------------------------------------- queries

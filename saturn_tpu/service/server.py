@@ -74,6 +74,9 @@ class SaturnService:
         recovery_policy: str = "pause-resolve-resume",
         replan_degrade_factor: float = 2.0,
         pressure_policy: str = "evict-lowest-priority",
+        durability_dir: Optional[str] = None,
+        task_provider=None,
+        crash_barrier=None,
         poll_s: float = 0.05,
         log: bool = False,
     ):
@@ -126,6 +129,97 @@ class SaturnService:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
+        # Crash-safe durability: open (and recover) the write-ahead journal,
+        # replay it into the queue, and warm-start the first re-solve from
+        # the last committed plan. ``killed`` is set only by the crash
+        # harness's simulated SIGKILL.
+        self.journal = None
+        self.task_provider = task_provider
+        self.killed = False
+        self._recovered_plan: Optional[milp.Plan] = None
+        if durability_dir is not None:
+            self._recover_from(durability_dir, crash_barrier)
+        elif crash_barrier is not None:
+            raise ValueError("crash_barrier requires durability_dir")
+
+    def _recover_from(self, durability_dir: str, crash_barrier) -> None:
+        """Open the journal (rolling torn tails back to the durable cut),
+        rebuild the job registry from the committed records, and reconcile
+        journaled checkpoint publications against the disk."""
+        from saturn_tpu.durability import journal as jmod
+        from saturn_tpu.durability import recovery as rmod
+
+        self.journal = jmod.Journal(durability_dir, barrier=crash_barrier)
+        self.queue.observer = self._observe_job
+        self.admission.journal = self.journal
+        state = rmod.replay_service_state(durability_dir)
+        if state.checkpoints:
+            rmod.reconcile_checkpoints(state.checkpoints)
+        if state.jobs:
+            restored = rmod.build_restore_records(state, self.task_provider)
+            for rec in restored:
+                self.queue.restore(rec)
+                j = state.jobs.get(rec.job_id)
+                if (j is not None and not j.terminal
+                        and rec.state is JobState.DONE):
+                    # Fully-realized job whose DONE verdict died un-fsync'd:
+                    # re-journal the terminal record so later incarnations
+                    # replay it as terminal directly.
+                    self._observe_job("state", rec)
+            logger.info(
+                "recovery: restored %d job(s) from %s (%d live)",
+                len(restored), durability_dir, len(state.live_jobs()),
+            )
+        if state.plan is not None:
+            try:
+                self._recovered_plan = milp.Plan.from_json(state.plan)
+            except Exception:
+                logger.exception(
+                    "recovery: last committed plan unusable — first "
+                    "re-solve starts cold"
+                )
+        self.journal.log(
+            "recovery", incarnation=state.incarnations + 1,
+            replayed_seq=state.last_seq, replayed_records=state.n_records,
+            live_jobs=len(state.live_jobs()),
+        )
+
+    def _observe_job(self, event: str, rec: JobRecord, **fields) -> None:
+        """Queue observer → write-ahead journal (called under the queue
+        lock; lock order is queue → journal, never the reverse).
+
+        Submissions group-commit immediately — ``submit()`` returning is the
+        client's durable ack. Lifecycle edges are buffered and ride the next
+        group commit, except terminal states which commit so a completed /
+        failed / evicted verdict is never lost."""
+        jnl = self.journal
+        if jnl is None:
+            return
+        if event == "submitted":
+            jnl.log(
+                "job_submitted", job=rec.job_id, task=rec.name,
+                priority=rec.request.priority,
+                deadline_s=rec.request.deadline_s,
+                max_retries=rec.request.max_retries,
+                total_batches=getattr(rec.task, "total_batches", None),
+                spec=rec.request.spec,
+            )
+        elif event == "recovered":
+            jnl.append(
+                "job_recovered", job=rec.job_id, task=rec.name,
+                requeues=rec.requeues,
+            )
+        elif event == "state":
+            jnl.append(
+                "job_state", job=rec.job_id, state=rec.state.value,
+                attempts=rec.attempts, requeues=rec.requeues,
+                error=rec.error,
+            )
+            if rec.state in (
+                JobState.DONE, JobState.FAILED, JobState.EVICTED
+            ):
+                jnl.commit()
+
     # -------------------------------------------------------------- control
     def start(self) -> "SaturnService":
         if self._thread is not None:
@@ -150,12 +244,23 @@ class SaturnService:
         self._stop.set()  # an idle loop re-checks every poll_s
         if self._thread is not None:
             self._thread.join(timeout)
-        if self._error is not None:
+        if self._error is not None and not self.killed:
             raise RuntimeError("service loop crashed") from self._error
 
     def _run_guarded(self) -> None:
+        from saturn_tpu.resilience.crash import SimulatedKill
+
         try:
             self._run()
+        except SimulatedKill as e:
+            # Simulated process death: a real SIGKILL runs no handlers, so
+            # do NOT fail jobs, flush the journal, or clean anything up —
+            # the in-memory state just stops existing. Recovery is the next
+            # incarnation's problem (that's the point).
+            self.killed = True
+            self._error = e
+            self._ready.set()
+            logger.warning("service loop killed by crash harness: %s", e)
         except BaseException as e:  # surfaced by stop()/wait()
             self._error = e
             self._ready.set()
@@ -177,9 +282,31 @@ class SaturnService:
     def _run(self) -> None:
         topo = self.topology
         tlimit = self.solver_time_limit
-        plan: Optional[milp.Plan] = None
+        # The last committed plan warm-starts the first post-restart
+        # re-solve — recovered jobs land back in (approximately) the slots
+        # they durably held.
+        plan: Optional[milp.Plan] = self._recovered_plan
+        self._recovered_plan = None
         jobs: Dict[str, JobRecord] = {}   # task name -> live admitted record
         interval_index = 0
+        jnl = self.journal
+
+        from saturn_tpu.utils import checkpoint as ckpt_mod
+
+        ckpt_hook = None
+        if jnl is not None:
+            def ckpt_hook(task_name, path):  # journal every publication
+                jnl.append("ckpt_published", task=task_name, path=path)
+
+            ckpt_mod.add_publish_hook(ckpt_hook)
+        try:
+            self._run_loop(topo, tlimit, plan, jobs, interval_index)
+        finally:
+            if ckpt_hook is not None:
+                ckpt_mod.remove_publish_hook(ckpt_hook)
+
+    def _run_loop(self, topo, tlimit, plan, jobs, interval_index) -> None:
+        jnl = self.journal
 
         with metrics.scoped(self.metrics_path):
             self._ready.set()
@@ -226,6 +353,9 @@ class SaturnService:
                                     "job_evicted", job=rec.job_id,
                                     task=name, reason="topology-change",
                                 )
+                        if jnl is not None:
+                            jnl.append("topology_change",
+                                       **change.to_fields())
                     elif change is not None:  # degrade: advisory only
                         metrics.event("topology_change", **change.to_fields())
 
@@ -286,6 +416,14 @@ class SaturnService:
                     "solve", makespan_s=plan.makespan, n_tasks=len(tasks),
                     solve_s=round(timeit.default_timer() - t_solve, 6),
                 )
+                if jnl is not None:
+                    # The committed plan is the recovery warm start; commit
+                    # here so a kill mid-interval restarts from THIS plan.
+                    jnl.append(
+                        "plan_commit", interval=interval_index,
+                        makespan=plan.makespan, plan=plan.to_json(),
+                    )
+                    jnl.commit()
                 for rec in newly_admitted:
                     if rec.name not in jobs:
                         continue  # evicted by the cancel sweep / load shed
@@ -309,7 +447,13 @@ class SaturnService:
                         failure_policy="drop", health=self.health,
                         faults=self.faults, interval_index=interval_index,
                         on_task_start=self._make_on_start(jobs),
+                        on_task_done=self._make_on_done(jobs),
                     )
+                    if jnl is not None:
+                        # Work ran; its task_progress records are buffered
+                        # but NOT yet durable — the canonical lost-progress
+                        # kill window.
+                        jnl.barrier("mid-interval", interval=interval_index)
                 else:
                     # every start is beyond this interval: resolve() slides
                     # work forward next cycle; don't spin
@@ -381,8 +525,18 @@ class SaturnService:
 
                 metrics.event("queue_depth", depth=self.queue.depth(),
                               live=self.queue.live(), active=len(jobs))
+                if jnl is not None:
+                    # Interval-end group commit: one fsync makes this
+                    # interval's realized iterations, lifecycle edges and
+                    # checkpoint publications durable together.
+                    jnl.commit()
+                    jnl.barrier("post-checkpoint", interval=interval_index)
                 interval_index += 1
 
+        # Clean shutdown only — a simulated kill unwinds past this (a real
+        # SIGKILL would never run it, and recovery must not depend on it).
+        if jnl is not None:
+            jnl.close()
         logger.info("service loop exited (%d jobs seen)",
                     len(self.queue.jobs()))
 
@@ -403,6 +557,23 @@ class SaturnService:
                 self.queue.mark(rec, JobState.RUNNING)
 
         return on_start
+
+    def _make_on_done(self, jobs: Dict[str, JobRecord]):
+        """Engine per-task completion hook → buffered ``task_progress``
+        records (durable at the interval-end group commit). This is the
+        exactly-once ledger: recovery subtracts these from the budget, so a
+        batch is journaled only after its iterations really ran."""
+        jnl = self.journal
+        if jnl is None:
+            return None
+        ids = {name: rec.job_id for name, rec in jobs.items()}
+
+        def on_done(name: str, batches: int) -> None:
+            if batches > 0:
+                jnl.append("task_progress", task=name, job=ids.get(name),
+                           batches=int(batches))
+
+        return on_done
 
     def _evict(self, jobs: Dict[str, JobRecord], rec: JobRecord,
                reason: str) -> None:

@@ -47,7 +47,9 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
+import time
 import timeit
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -78,6 +80,8 @@ def search(
     profile_cache: Any = None,
     prune: bool = True,
     compile_cache_dir: Optional[str] = None,
+    trial_retries: int = 2,
+    retry_backoff_s: float = 0.05,
 ) -> Dict[str, int]:
     """Fill ``task.strategies`` for every task in place.
 
@@ -96,6 +100,15 @@ def search(
     additionally roots JAX's persistent compilation cache there for this
     process (same effect as ``SATURN_TPU_COMPILE_CACHE_DIR``).
 
+    ``trial_retries``: extra attempts for a trial whose technique *raises*
+    (transient fleet flake — a device hiccup mid-compile, an injected
+    crash); each retry backs off ``retry_backoff_s * 2^attempt`` seconds
+    plus deterministic jitter and emits a ``trial_retry`` event. A clean
+    infeasible verdict (memory analysis rejection) is a *result*, not a
+    flake, and is never retried — retrying it would only re-pay the
+    compile; conversely, without retries a transient crash would be
+    cached as permanently infeasible.
+
     Returns sweep stats ``{"trials_run", "cache_hits", "pruned",
     "interpolated"}`` — the online admission controller uses ``trials_run``
     to distinguish warm (zero-trial) from cold arrivals.
@@ -107,7 +120,8 @@ def search(
     cache = pcache.resolve(profile_cache)
     with metrics.scoped(metrics_path), trace.profile_trace(trace_dir):
         return _search_inner(
-            tasks, technique_names, topology, parallel_trials, cache, prune
+            tasks, technique_names, topology, parallel_trials, cache, prune,
+            trial_retries=trial_retries, retry_backoff_s=retry_backoff_s,
         )
 
 
@@ -211,7 +225,8 @@ class _EtaTracker:
 
 
 def _search_inner(
-    tasks, technique_names, topology, parallel_trials=None, cache=None, prune=True
+    tasks, technique_names, topology, parallel_trials=None, cache=None,
+    prune=True, trial_retries=2, retry_backoff_s=0.05,
 ) -> Dict[str, int]:
     topo = topology if topology is not None else SliceTopology()
     if technique_names is None and not lib.registered_names():
@@ -322,11 +337,42 @@ def _search_inner(
             metrics.event("profile_cache", hit=False, task=task.name, size=g,
                           technique=name)
         t0 = timeit.default_timer()
-        try:
-            params, per_batch_time = tech.search(task, devices, tid)
-        except Exception as e:  # a broken trial must not kill the sweep (``:27-28``)
-            logger.info("trial (%s, g=%d, %s) raised: %r", task.name, g, name, e)
-            params, per_batch_time = None, None
+        params = per_batch_time = None
+        attempt = 0
+        while True:
+            try:
+                params, per_batch_time = tech.search(task, devices, tid)
+                break
+            except Exception as e:  # a broken trial must not kill the sweep (``:27-28``)
+                if attempt >= max(0, trial_retries):
+                    logger.info(
+                        "trial (%s, g=%d, %s) raised on attempt %d "
+                        "(budget exhausted): %r",
+                        task.name, g, name, attempt + 1, e,
+                    )
+                    params, per_batch_time = None, None
+                    break
+                # Exponential backoff with deterministic jitter — seeded per
+                # (trial, attempt) so concurrent lanes desynchronize but runs
+                # stay reproducible.
+                delay = retry_backoff_s * (2 ** attempt)
+                jitter = random.Random(
+                    f"{task.name}:{g}:{name}:{attempt}"
+                ).random()
+                delay *= 1.0 + jitter
+                metrics.event(
+                    "trial_retry", task=task.name, size=g, technique=name,
+                    attempt=attempt + 1, backoff_s=round(delay, 6),
+                    error=repr(e),
+                )
+                logger.info(
+                    "trial (%s, g=%d, %s) raised (attempt %d/%d), retrying "
+                    "in %.3fs: %r",
+                    task.name, g, name, attempt + 1, trial_retries + 1,
+                    delay, e,
+                )
+                time.sleep(delay)
+                attempt += 1
         dt = timeit.default_timer() - t0
         if params is None or per_batch_time is None:
             report = None

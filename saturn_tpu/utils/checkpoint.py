@@ -31,6 +31,84 @@ from saturn_tpu.utils.treepath import path_str as _path_str
 log = logging.getLogger("saturn_tpu")
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint exists on disk but cannot be read back (truncated write,
+    bit rot, torn page). The unreadable file has already been quarantined to
+    a ``*.corrupt`` sidecar by the time this raises, so crash recovery can
+    fall back to the *previous* published checkpoint instead of dying on
+    the newest one."""
+
+    def __init__(self, path: str, quarantined: str, cause: str):
+        self.path = path
+        self.quarantined = quarantined
+        super().__init__(
+            f"checkpoint {path} is corrupt ({cause}); quarantined to "
+            f"{quarantined}"
+        )
+
+
+def quarantine(path: str) -> str:
+    """Rename an unreadable artifact to a ``*.corrupt`` sidecar (never
+    overwrite an earlier quarantine: ``.corrupt``, ``.corrupt.1``, ...).
+    Returns the sidecar path; if the rename itself fails the original path
+    is returned and the file is left in place (recovery treats both the
+    same — the path is not a usable checkpoint)."""
+    sidecar = path + ".corrupt"
+    n = 0
+    while os.path.exists(sidecar):
+        n += 1
+        sidecar = f"{path}.corrupt.{n}"
+    try:
+        os.replace(path, sidecar)
+    except OSError:
+        log.exception("failed to quarantine %s", path)
+        return path
+    return sidecar
+
+
+def verify(path: str) -> bool:
+    """Integrity-check a published ``.npz`` checkpoint without loading it
+    into memory: the zip central directory must parse and every member's
+    stored CRC-32 must match its payload (``testzip`` streams each entry).
+    False for missing, truncated or corrupt files — never raises."""
+    import zipfile
+
+    try:
+        with zipfile.ZipFile(path) as zf:
+            return zf.testzip() is None
+    except Exception:
+        return False
+
+
+# Publication hooks: called as ``hook(task_or_stem, path)`` after the atomic
+# rename lands a checkpoint, from whichever thread performed the write (the
+# async writer thread for ``save_async``). The durability layer registers one
+# to journal every publication; hooks must be cheap and must not raise.
+_PUBLISH_HOOKS: list = []
+
+
+def add_publish_hook(hook) -> None:
+    _PUBLISH_HOOKS.append(hook)
+
+
+def remove_publish_hook(hook) -> None:
+    try:
+        _PUBLISH_HOOKS.remove(hook)
+    except ValueError:
+        pass
+
+
+def _notify_published(path: str) -> None:
+    if not _PUBLISH_HOOKS:
+        return
+    stem = os.path.splitext(os.path.basename(path))[0]
+    for hook in list(_PUBLISH_HOOKS):
+        try:
+            hook(stem, os.path.abspath(path))
+        except Exception:
+            log.exception("checkpoint publish hook failed for %s", path)
+
+
 def _writer_rank(tree: Any) -> int:
     """The process that writes this tree: the lowest process index that
     addresses its arrays. For a cross-host sharded/replicated state that is
@@ -106,6 +184,7 @@ def _write_atomic(path: str, arrays: Dict[str, np.ndarray]) -> None:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
         os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+        _notify_published(path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -215,8 +294,19 @@ def restore(path: str, template: Any) -> Any:
     host alone and a cluster-wide barrier would deadlock.
     """
     _wait_pending(path)  # an async save to this path may still be in flight
-    with np.load(path) as data:
-        saved = {k: data[k] for k in data.files}
+    try:
+        with np.load(path) as data:
+            saved = {k: data[k] for k in data.files}
+    except FileNotFoundError:
+        raise  # absent is not corrupt: callers branch on exists()
+    except Exception as e:
+        # Truncated / torn / bit-rotted archive: quarantine it so the next
+        # reader (and crash recovery) falls back to the previous checkpoint
+        # instead of re-hitting the same unreadable file.
+        sidecar = quarantine(path)
+        log.warning("checkpoint %s unreadable (%r); quarantined to %s",
+                    path, e, sidecar)
+        raise CheckpointCorruptError(path, sidecar, repr(e)) from e
 
     def replace(tree_path, leaf):
         key = _path_str(tree_path)

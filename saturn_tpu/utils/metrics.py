@@ -14,9 +14,13 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
+import os
 import threading
 import time
 from typing import Optional
+
+logger = logging.getLogger("saturn_tpu")
 
 
 class MetricsWriter:
@@ -42,7 +46,17 @@ class MetricsWriter:
             pass
 
     def close(self) -> None:
+        """Close the stream, fsyncing first: ``configure``/``scoped`` rotate
+        sinks by closing the old writer, so rotation is a durability point —
+        a crash right after must not lose the rotated-out events to the page
+        cache."""
         with self._lock:
+            if not self._fh.closed:
+                try:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                except (OSError, ValueError):
+                    pass
             self._fh.close()
 
 
@@ -69,16 +83,23 @@ def event(kind: str, **fields) -> None:
 def read_events(path: str, kind: Optional[str] = None) -> list:
     """Read a JSONL metrics file back as dicts, optionally filtered by
     ``kind`` — the test/analysis counterpart to :func:`event`. Lines that
-    fail to parse (a crashed writer's torn tail) are skipped."""
+    fail to parse (a crashed writer's torn tail) are skipped with a
+    WARNING — losing the last in-flight event to a crash is expected,
+    losing it *silently* is not."""
     out = []
     with open(path) as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                logger.warning(
+                    "metrics: skipping torn/corrupt line %d of %s "
+                    "(%d bytes) — a crashed writer's unflushed tail",
+                    lineno, path, len(line),
+                )
                 continue
             if kind is None or rec.get("kind") == kind:
                 out.append(rec)
@@ -109,6 +130,12 @@ def tail_events(path: str, kind: Optional[str] = None,
                     try:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
+                        # A mid-file torn line: a pre-crash writer's tail
+                        # that a restarted writer appended past.
+                        logger.warning(
+                            "metrics: skipping torn/corrupt line in %s "
+                            "(%d bytes)", path, len(line),
+                        )
                         continue
                     if kind is None or rec.get("kind") == kind:
                         yield rec

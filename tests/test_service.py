@@ -352,6 +352,40 @@ class TestServiceLoop:
         assert any(e.get("job") == jbad
                    for e in read_events(mpath, kind="job_failed"))
 
+    def test_retry_budget_exhaustion_is_terminal_and_journaled(self, tmp_path):
+        """Satellite check for the durability layer: a job that exhausts its
+        retry budget lands in FAILED *terminally* — the journal holds the
+        FAILED record (so a restart replays it as terminal, not re-runnable)
+        and the task name is immediately reusable."""
+        wal = str(tmp_path / "wal")
+        bad_tech = FailingTech(fail={"bad"})
+        svc = SaturnService(topology=topo(8), interval=0.15, poll_s=0.02,
+                            durability_dir=wal).start()
+        client = ServiceClient(svc)
+        try:
+            jbad = client.submit(FakeTask("bad", 30, [2], bad_tech),
+                                 max_retries=1)
+            out = client.wait(jbad, timeout=60)
+            assert out["state"] == "FAILED" and out["attempts"] == 2
+            # terminal failure released the name: resubmission under the
+            # same task name admits cleanly
+            jre = client.submit(FakeTask("bad", 20, [2], RecordingTech()))
+            assert client.wait(jre, timeout=60)["state"] == "DONE"
+        finally:
+            svc.stop(timeout=30)
+
+        from saturn_tpu.durability import replay, replay_service_state
+
+        states = [r["data"]["state"] for r in replay(wal, strict=True)
+                  if r["kind"] == "job_state" and r["data"]["job"] == jbad]
+        assert states[-1] == "FAILED"
+        # a restart would replay the job as terminal — no resurrection, no
+        # task_provider required
+        replayed = replay_service_state(wal)
+        assert replayed.jobs[jbad].terminal
+        assert replayed.jobs[jbad].error
+        assert not [j for j in replayed.live_jobs()]
+
     def test_admission_pressure_sheds_lowest_priority(self, tmp_path):
         """Deadline slack exhausted -> the service reuses the replanner's
         evict-lowest-priority policy to shed load."""
