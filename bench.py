@@ -25,8 +25,13 @@ baseline key — a health signal that always parses, not a perf claim.
 ``SATURN_BENCH_FORCE_DEGRADED=1`` skips the probe for testing.
 
 The probe outcome is persisted in a TTL'd sentinel file (tmpdir, keyed on
-boot id) so back-to-back runs don't re-burn the 2 x 75 s probe timeouts
-before every CPU fallback; ``SATURN_BENCH_PROBE_CACHE=0`` disables it.
+boot id) so back-to-back runs don't re-burn the probe timeout before every
+CPU fallback; ``SATURN_BENCH_PROBE_CACHE=0`` disables it. Round-10: a probe
+timeout also short-circuits the in-run retry loop (BENCH_r05 still paid
+2 x 75 s because the sentinel only helped the *next* run) — see
+``_probe_backend`` — and the degraded run disables XLA:CPU's thunk runtime
+(probed for flag support first), whose per-op dispatch overhead was
+throttling the 1-core host ~5x — see ``_degraded_cpu_flag``.
 """
 
 from __future__ import annotations
@@ -111,9 +116,17 @@ def _store_probe(platform) -> None:
 def _probe_backend(timeout_s: float = 75.0, retries: int = 1, delay_s: float = 5.0):
     """Probe default-backend availability in a subprocess (bounded time).
 
-    Returns the platform string on success, None after all retries fail.
-    A subprocess keeps a wedged TPU tunnel from hanging or poisoning the
-    parent's backend cache.
+    Returns the platform string on success, None on failure. A subprocess
+    keeps a wedged TPU tunnel from hanging or poisoning the parent's
+    backend cache.
+
+    A probe that burns its FULL timeout is a wedged tunnel, not a flaky
+    init: retrying has never been observed to recover it, and BENCH_r05
+    paid 2 x 75 s per run doing so — the TTL sentinel only short-circuited
+    the NEXT run, not the retry loop inside this one. So a timeout now
+    records the failure in the sentinel immediately and returns; the retry
+    budget applies only to fast failures (rc != 0), which genuinely are
+    transient (``UNAVAILABLE`` through the tunnel, BENCH_r01).
     """
     code = "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform)"
     for attempt in range(retries + 1):
@@ -136,12 +149,66 @@ def _probe_backend(timeout_s: float = 75.0, retries: int = 1, delay_s: float = 5
         except subprocess.TimeoutExpired:
             print(
                 f"bench: backend probe attempt {attempt + 1} timed out "
-                f"after {timeout_s}s",
+                f"after {timeout_s}s — wedged tunnel, not retrying",
                 file=sys.stderr,
             )
+            _store_probe(None)
+            return None
         if attempt < retries:
             time.sleep(delay_s)
     return None
+
+
+def _degraded_cpu_flag() -> str:
+    """XLA flag for the degraded CPU run: disable the thunk runtime.
+
+    On the 1-core CI host the thunk runtime's per-op dispatch overhead
+    dominates the b2x256 step (round 10 measured ~33 tokens/s thunk vs ~165
+    legacy — same HLO, same numerics, 5x wall clock), the in-process analog
+    of the per-step Python dispatch overhead the fused-scan pipeline
+    removes. XLA FATALLY aborts on unknown flags at backend init
+    (``parse_flags_from_env.cc``), so probe support in a subprocess first —
+    the same pattern as tests/conftest.py — and cache the verdict keyed on
+    the jaxlib version (the probe costs a ~5s jax import).
+
+    Returns the flag string, or "" when unsupported/unprobeable.
+    """
+    import tempfile
+
+    flag = "--xla_cpu_use_thunk_runtime=false"
+    try:
+        import jaxlib.version
+
+        ver = jaxlib.version.__version__
+    except Exception:
+        return ""
+    sentinel = os.path.join(tempfile.gettempdir(), "saturn_bench_cpu_flag.json")
+    try:
+        with open(sentinel) as f:
+            rec = json.load(f)
+        if rec.get("jaxlib") == ver:
+            return flag if rec["supported"] else ""
+    except (OSError, ValueError, KeyError):
+        pass
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = flag
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, env=env, timeout=120,
+        )
+        ok = r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return ""  # don't cache a timeout: says nothing about the flag
+    try:
+        tmp = f"{sentinel}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"jaxlib": ver, "supported": ok}, f)
+        os.replace(tmp, sentinel)
+    except OSError:
+        pass
+    return flag if ok else ""
 
 
 def _flops_per_step(cfg, batch_size: int, seq_len: int, n_params: int) -> float:
@@ -183,6 +250,11 @@ def main() -> None:
     degraded = platform is None or platform == "cpu"
     if degraded:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        cpu_flag = _degraded_cpu_flag()
+        if cpu_flag and cpu_flag not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + cpu_flag
+            ).strip()
         reason = ("unavailable after retries" if platform is None
                   else "absent (probe returned cpu)")
         print(f"bench: TPU backend {reason}; reduced CPU workload",

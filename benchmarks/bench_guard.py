@@ -1,0 +1,120 @@
+"""Bench regression guard: fail if headline throughput drops >10%.
+
+Compares a fresh ``bench.py`` run against the most recent recorded
+``BENCH_r*.json`` in the repo root (the driver's per-round bench archive).
+The comparison is shape-aware: a degraded (b2x256 CPU) record only gates
+degraded runs on the same platform — a TPU number must never gate a CPU
+fallback or vice versa (the per-shape baseline-key rule from round 4).
+
+Prints ONE JSON line and exits non-zero on regression:
+
+    {"metric": "bench_guard", "status": "ok"|"regression"|"skipped",
+     "value": <new tokens/s>, "reference": <recorded tokens/s>, ...}
+
+Run: ``python benchmarks/bench_guard.py`` (CI) — threshold overridable via
+``SATURN_BENCH_GUARD_PCT`` (default 10).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def latest_record():
+    """(round, parsed-result) of the newest BENCH_r*.json with a parsed
+    value, or None when no usable record exists (fresh clone)."""
+    best = None
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        value = parsed.get("value")
+        if not isinstance(value, (int, float)) or value <= 0:
+            continue
+        n = int(m.group(1))
+        if best is None or n > best[0]:
+            best = (n, parsed)
+    return best
+
+
+def run_bench() -> dict:
+    """Run bench.py in a subprocess and parse its single JSON stdout line."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=1200,
+    )
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"bench.py produced no JSON line (rc={r.returncode}): "
+        f"{(r.stderr or r.stdout).strip().splitlines()[-1:]}"
+    )
+
+
+def shape_key(parsed: dict) -> tuple:
+    """What must match for two bench numbers to be comparable."""
+    return (
+        parsed.get("platform"),
+        parsed.get("batch_size"),  # only present on degraded runs
+        parsed.get("seq_len"),
+    )
+
+
+def main() -> int:
+    ref = latest_record()
+    threshold = float(os.environ.get("SATURN_BENCH_GUARD_PCT", "10")) / 100.0
+    if ref is None:
+        print(json.dumps({
+            "metric": "bench_guard", "status": "skipped",
+            "reason": "no BENCH_r*.json with a parsed value",
+        }))
+        return 0
+    n, parsed_ref = ref
+    new = run_bench()
+    out = {
+        "metric": "bench_guard",
+        "value": new.get("value"),
+        "reference": parsed_ref["value"],
+        "reference_round": n,
+        "threshold_pct": threshold * 100.0,
+    }
+    if shape_key(new) != shape_key(parsed_ref):
+        # e.g. the reference is a degraded CPU record but this host has a
+        # live TPU — different workload shapes, no comparison to make.
+        out["status"] = "skipped"
+        out["reason"] = (
+            f"shape mismatch: ran {shape_key(new)} vs "
+            f"recorded {shape_key(parsed_ref)}"
+        )
+        print(json.dumps(out))
+        return 0
+    floor = parsed_ref["value"] * (1.0 - threshold)
+    if new.get("value", 0.0) < floor:
+        out["status"] = "regression"
+        out["floor"] = round(floor, 1)
+        print(json.dumps(out))
+        return 1
+    out["status"] = "ok"
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,158 @@
+"""Async step pipeline microbenchmark: per-step vs fused vs fused+prefetch.
+
+Measures the three dispatch shapes of ``SPMDTechnique.execute`` on a CPU
+fixture (tiny GPT-2, single device, dp):
+
+- ``per_step``: the pre-round-10 hot loop — synchronous host staging
+  (numpy slice + device_put) alternating with one jitted step per batch;
+- ``fused``: K-step ``lax.scan`` windows (one dispatch + one loss readback
+  per window), staging still synchronous;
+- ``fused_prefetch``: fused windows with staging moved to the
+  ``DevicePrefetcher`` background thread — what execute() now runs.
+
+Prints ONE JSON line like ``bench.py``:
+
+    {"metric": "step_pipeline_tokens_per_sec", "value": <fused_prefetch>,
+     "per_step": ..., "fused": ..., "speedup_vs_per_step": ..., ...}
+
+Hardware-free by construction (CPU forced before jax imports). The shape is
+deliberately small (batch 1 x seq 16, single device, K=16): the CI host has
+ONE core, so there is no second core for the prefetch thread to overlap on
+and the measurable win is dispatch amortization — which scales with the
+dispatch:compute ratio, hence a small step. On real TPUs both terms grow:
+per-step dispatch is host Python against microsecond device steps, and the
+prefetch overlap hides real PCIe transfer time. Run:
+``python benchmarks/step_pipeline.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import timeit
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from saturn_tpu import HParams, Task
+from saturn_tpu.data.lm_dataset import make_lm_dataset
+from saturn_tpu.data.prefetch import DevicePrefetcher
+from saturn_tpu.models.gpt2 import build_gpt2
+from saturn_tpu.models.loss import pretraining_loss
+from saturn_tpu.parallel.dp import DataParallel
+
+N_BATCHES = 256
+WINDOW = 16
+BATCH_SIZE = 1
+SEQ_LEN = 16
+
+
+def make_task(save_dir: str) -> Task:
+    return Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", seq_len=SEQ_LEN, **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=SEQ_LEN, batch_size=BATCH_SIZE, vocab_size=256,
+            n_tokens=SEQ_LEN * BATCH_SIZE * 32,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=N_BATCHES),
+        chip_range=[1],
+        name="pipeline-bench",
+        save_dir=save_dir,
+    )
+
+
+def run_per_step(bundle, ds, n: int) -> float:
+    state = bundle.init()
+    loss = None
+    t0 = timeit.default_timer()
+    for i in range(n):
+        batch = jax.device_put(ds.batch(i), bundle.batch_sharding)
+        state, loss = bundle.compiled(state, batch)
+    float(np.asarray(jax.device_get(loss)))
+    return timeit.default_timer() - t0
+
+
+def run_fused(bundle, ds, n: int, k: int, prefetch: bool) -> float:
+    fused = bundle.fused_compiled(k)
+    sharding = bundle.stacked_sharding()
+    n_windows = n // k
+
+    def stage(w: int) -> object:
+        host = np.stack([np.asarray(ds.batch(w * k + j)) for j in range(k)])
+        return jax.device_put(host, sharding)
+
+    state = bundle.init()
+    loss = None
+    t0 = timeit.default_timer()
+    if prefetch:
+        pf = DevicePrefetcher(n_windows, stage, depth=2)
+        try:
+            for window in pf:
+                state, loss = fused(state, window)
+        finally:
+            pf.close()
+    else:
+        for w in range(n_windows):
+            state, loss = fused(state, stage(w))
+    float(np.asarray(jax.device_get(loss)).reshape(-1)[-1])
+    return timeit.default_timer() - t0
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as tmp:
+        task = make_task(tmp)
+        tech = DataParallel()
+        devices = jax.devices()[:1]
+        bundle = tech.build(task, devices, {})
+        ds = task.get_dataset()
+
+        # Compile + warm every program outside the timed regions, then run
+        # each mode twice and keep the faster pass (CPU timer noise).
+        run_per_step(bundle, ds, 2)
+        run_fused(bundle, ds, 2 * WINDOW, WINDOW, prefetch=False)
+
+        tokens = N_BATCHES * BATCH_SIZE * SEQ_LEN
+        results = {}
+        for name, fn in (
+            ("per_step", lambda: run_per_step(bundle, ds, N_BATCHES)),
+            ("fused", lambda: run_fused(bundle, ds, N_BATCHES, WINDOW, False)),
+            ("fused_prefetch",
+             lambda: run_fused(bundle, ds, N_BATCHES, WINDOW, True)),
+        ):
+            dt = min(fn(), fn())
+            results[name] = tokens / dt
+
+    out = {
+        "metric": "step_pipeline_tokens_per_sec",
+        "value": round(results["fused_prefetch"], 1),
+        "unit": "tokens/s",
+        "per_step": round(results["per_step"], 1),
+        "fused": round(results["fused"], 1),
+        "fused_prefetch": round(results["fused_prefetch"], 1),
+        "speedup_vs_per_step": round(
+            results["fused_prefetch"] / results["per_step"], 3
+        ),
+        "window": WINDOW,
+        "n_batches": N_BATCHES,
+        "batch_size": BATCH_SIZE,
+        "seq_len": SEQ_LEN,
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

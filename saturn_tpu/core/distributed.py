@@ -169,12 +169,20 @@ def put_tree_global(tree, shardings):
 def host_scalar(x) -> float:
     """Read a (replicated) device scalar on every process — ``device_get``
     refuses arrays that are not fully addressable."""
+    return float(host_array(x))
+
+
+def host_array(x):
+    """Read a (replicated) device array on every process — the array-valued
+    sibling of :func:`host_scalar`, for the fused multi-step window's (K,)
+    per-step loss vector. Replicated outputs are whole on every device, so
+    one addressable shard carries the full value."""
     import jax
     import numpy as np
 
     if getattr(x, "is_fully_addressable", True):
-        return float(jax.device_get(x))
-    return float(np.asarray(x.addressable_data(0)))
+        return np.asarray(jax.device_get(x))
+    return np.asarray(x.addressable_data(0))
 
 
 def sync_task_state(task_list, src_ranks=None, updates=None) -> dict:

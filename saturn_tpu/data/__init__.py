@@ -1,0 +1,3 @@
+from saturn_tpu.data.prefetch import DevicePrefetcher
+
+__all__ = ["DevicePrefetcher"]

@@ -1,0 +1,127 @@
+"""Device-side batch prefetch: overlap host staging with device compute.
+
+The per-step hot loop (``parallel/spmd_base.py::execute``) used to alternate
+host work (numpy batch slicing + ``device_put``) with device work one step at
+a time, so the accelerator idled through every transfer — the host/device
+bubble MPMD-pipelining systems close by overlapping transfer with compute.
+:class:`DevicePrefetcher` closes it with the smallest mechanism that works:
+a background daemon thread stages unit i+1 onto the device (under the
+bundle's batch sharding) while the main thread runs unit i, double-buffered
+through a bounded queue so at most ``depth`` staged units are alive at once.
+
+JAX dispatch is thread-safe: ``device_put`` from the producer thread and the
+jitted step from the consumer thread enqueue onto the same device stream
+without coordination beyond the queue hand-off.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+_POLL_S = 0.1
+
+
+class DevicePrefetcher:
+    """Iterate device-staged values produced by a background thread.
+
+    ``stage(i)`` is called for ``i in range(n)`` on the producer thread and
+    must return the device-resident value for unit ``i`` (host slicing +
+    ``device_put``). Iteration yields those values in order.
+
+    Exceptions from ``stage`` — **including** ``BaseException`` subclasses
+    like the crash harness's ``SimulatedKill``, which ``except Exception``
+    would miss — are captured and re-raised in the consumer at the position
+    they occurred, so a kill barrier inside batch staging still unwinds the
+    interval exactly like the synchronous path did.
+
+    ``close()`` must run even on abnormal exits (use ``try/finally``): it
+    unblocks a producer parked on a full queue and joins the thread, so a
+    killed interval never leaks a producer that keeps slicing batches from a
+    task the harness is rolling back. Consuming every item closes
+    implicitly.
+    """
+
+    def __init__(self, n: int, stage: Callable[[int], Any], depth: int = 2):
+        self.n = int(n)
+        self._stage = stage
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._closed = threading.Event()
+        self._taken = 0
+        self._thread = threading.Thread(
+            target=self._produce, name="saturn-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _produce(self) -> None:
+        try:
+            for i in range(self.n):
+                if self._closed.is_set():
+                    return
+                item = self._stage(i)
+                if not self._offer(("ok", item)):
+                    return
+        except BaseException as e:  # SimulatedKill must cross the thread
+            self._offer(("err", e))
+
+    def _offer(self, msg) -> bool:
+        """Bounded put that gives up once the consumer closed us — a plain
+        blocking put would park this thread forever after an early close."""
+        while not self._closed.is_set():
+            try:
+                self._q.put(msg, timeout=_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self):
+        if self._taken >= self.n:
+            self.close()
+            raise StopIteration
+        while True:
+            try:
+                tag, val = self._q.get(timeout=_POLL_S)
+                break
+            except queue.Empty:
+                if self._closed.is_set():
+                    raise StopIteration
+                if not self._thread.is_alive():
+                    # always posts ("err", e) before dying, so an empty queue
+                    # with a dead producer is a bug worth failing loudly on
+                    raise RuntimeError(
+                        "prefetch producer thread died without posting a "
+                        "result or an error"
+                    )
+        if tag == "err":
+            self._taken = self.n
+            raise val
+        self._taken += 1
+        return val
+
+    def close(self) -> None:
+        """Stop the producer and join it (idempotent)."""
+        self._closed.set()
+        self._drain()  # a producer blocked on put() can now observe close
+        self._thread.join(timeout=5.0)
+        # The producer may have slipped one last item in between the drain
+        # and observing the close flag; drain again now that it is dead so
+        # post-close iteration deterministically sees an empty queue.
+        self._drain()
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

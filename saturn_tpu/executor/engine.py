@@ -74,11 +74,40 @@ def rollback_forecast(task, n_batches: int) -> None:
     strategy's remaining runtime is re-derived from its per-batch profile —
     the checkpoint is the ground truth the next attempt resumes from.
     Shared by the batch orchestrator's retry/preemption paths and the online
-    service's requeue path."""
+    service's requeue path.
+
+    Window granularity (fused multi-step dispatch) changes nothing here:
+    an interval is all-or-nothing — ``on_task_done`` only fires after the
+    technique ran every budgeted batch, so a preemption mid-window (or
+    mid-tail) discards the whole attempt and this rollback restores the
+    FULL forecast deduction, exactly. There is no partial-window credit to
+    account for: device state from a half-run scan program is unreachable,
+    and the end-of-interval checkpoint never happened.
+    """
     task.total_batches += n_batches
     for s in task.strategies.values():
         if s.feasible:
             s.runtime = s.per_batch_time * task.total_batches
+
+
+def pick_window(n_batches: int) -> int:
+    """Fused multi-step window K for an interval batch budget — the engine
+    side of the async step pipeline: K comes from the forecast's budget so
+    the technique runs ``n // K`` fused windows plus an exact per-step tail.
+    Delegates to the technique layer's policy (``SATURN_TPU_MAX_WINDOW``
+    cap); imported lazily to keep executor -> parallel a call-time edge."""
+    from saturn_tpu.parallel.spmd_base import choose_window
+
+    return choose_window(n_batches)
+
+
+def _execute_kwargs(tech, n_batches: int) -> Dict[str, int]:
+    """The optional kwargs this technique's ``execute`` accepts. Gated on
+    ``supports_windows`` so plugin techniques (and test fakes) with the bare
+    ``BaseTechnique`` signature keep working unchanged."""
+    if getattr(tech, "supports_windows", False):
+        return {"window_size": pick_window(n_batches)}
+    return {}
 
 
 def _check_disjoint(run_tasks, plan) -> None:
@@ -241,7 +270,8 @@ def execute(
                 task.name, a.block.offset, a.block.end, n,
             )
             t_run = timeit.default_timer()
-            tech.execute(task, devices, tid, override_batch_count=n)
+            tech.execute(task, devices, tid, override_batch_count=n,
+                         **_execute_kwargs(tech, n))
             dt_run = timeit.default_timer() - t_run
             if didx and health.any_lost(didx):
                 # chips died under the run: the device state is gone, the
@@ -288,6 +318,10 @@ def execute(
             n for n, e in errors.items() if isinstance(e, PreemptedError)
         ),
     )
+    # Interval boundary: drain the buffered metrics writer — emission is off
+    # the step critical path, but an interval's telemetry must land before
+    # the next interval starts (live tail_events followers, crash windows).
+    metrics.flush()
     if failure_policy == "raise":
         real = {
             n: e for n, e in errors.items() if not isinstance(e, PreemptedError)
@@ -343,8 +377,10 @@ def _execute_multihost(
                     "interval[mh]: %s on block [%d:%d] for %d batches",
                     task.name, a.block.offset, a.block.end, n,
                 )
-                task.selected_strategy.executor.execute(
-                    task, devices, tid, override_batch_count=n
+                tech = task.selected_strategy.executor
+                tech.execute(
+                    task, devices, tid, override_batch_count=n,
+                    **_execute_kwargs(tech, n)
                 )
             task.reconfigure(batches[task.name])
         except BaseException as e:
@@ -377,4 +413,5 @@ def _execute_multihost(
         "interval", elapsed_s=elapsed, planned_s=interval,
         n_tasks=len(run_tasks), failed=[],
     )
+    metrics.flush()
     return errors

@@ -630,6 +630,10 @@ def _orchestrate_loop(
                     journal.append("interval_commit",
                                    interval=interval_index)
                     journal.commit()
+                # Interval boundary for the buffered metrics writer too:
+                # telemetry rides the buffer during the hot loop and lands
+                # here, with the journal commit.
+                metrics.flush()
                 interval_index += 1
     logger.info("orchestration complete (%d completed, %d failed)",
                 len(all_completed), len(all_failed))

@@ -16,8 +16,10 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
+import threading
 import timeit as _timeit
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -29,7 +31,12 @@ from saturn_tpu.core.mesh import make_submesh
 from saturn_tpu.core.technique import BaseTechnique
 from saturn_tpu.parallel import sharding as shr
 from saturn_tpu.utils import checkpoint as ckpt
-from saturn_tpu.utils.timing import device_hbm_bytes, hbm_bytes_required, time_train_step
+from saturn_tpu.utils.timing import (
+    device_hbm_bytes,
+    hbm_bytes_required,
+    time_fused_window,
+    time_train_step,
+)
 
 log = logging.getLogger("saturn_tpu")
 
@@ -37,6 +44,57 @@ log = logging.getLogger("saturn_tpu")
 def _stage_to_device(tree):
     """Move a (possibly pinned-host) tree into device memory inside jit."""
     return jax.device_put(tree, jax.memory.Space.Device)
+
+
+# ------------------------------------------------------- fused-window policy
+#: Default ceiling on the fused multi-step window K (``lax.scan`` over a
+#: stacked window of K batches inside one jitted call). K trades per-step
+#: Python dispatch + per-step loss readback against staged-batch memory
+#: ((K, B, T) tokens resident at once) and progress granularity — a window
+#: is all-or-nothing under preemption, and the interval's batch budget is
+#: only exact at window boundaries.
+DEFAULT_MAX_WINDOW = 8
+
+_ENV_MAX_WINDOW = "SATURN_TPU_MAX_WINDOW"
+
+
+def max_window() -> int:
+    """Ceiling on the fused window K (env ``SATURN_TPU_MAX_WINDOW``).
+
+    ``<= 1`` disables fused dispatch entirely — every interval runs the
+    exact legacy per-step path.
+    """
+    try:
+        k = int(os.environ.get(_ENV_MAX_WINDOW, DEFAULT_MAX_WINDOW))
+    except ValueError:
+        return DEFAULT_MAX_WINDOW
+    return max(1, k)
+
+
+def choose_window(n_batches: int, cap: Optional[int] = None) -> int:
+    """Fused window size K for an interval budget of ``n_batches``.
+
+    The largest window under the cap that the budget can fill at least
+    once; 1 (the exact per-step fallback) when the interval is too short to
+    amortize a fused program or fused dispatch is disabled. The interval
+    then runs ``n // K`` fused windows plus an ``n % K`` per-step tail, so
+    every budgeted batch runs and loss trajectories stay bit-identical to
+    the 1-step path.
+    """
+    cap = max_window() if cap is None else int(cap)
+    n = int(n_batches)
+    if cap <= 1 or n < 2:
+        return 1
+    return min(cap, n)
+
+
+def dispatch_signature() -> str:
+    """Content signature of the execution dispatch mode, for the profile
+    cache key (``utils/profile_cache.fingerprint``): per-step trial profiles
+    must not warm-start fused-dispatch sweeps (and vice versa) — the two
+    modes have genuinely different per-batch times, which is the point."""
+    k = max_window()
+    return f"fused-scan-v1:k{k}" if k > 1 else "per-step"
 
 
 @dataclass
@@ -50,7 +108,11 @@ class _Bundle:
     state_shardings: Any
     batch_sharding: Any
     lowered: Any              # jit(...).lower(...) result, for memory analysis
+    train_step: Any = None    # raw python step fn (fused scan re-traces it)
+    batch_sds: Any = None     # ShapeDtypeStruct of one host batch
     _compiled: Any = None
+    _fused: Dict[int, Any] = field(default_factory=dict)
+    _fused_lock: Any = field(default_factory=threading.Lock)
 
     @property
     def compiled(self):
@@ -60,6 +122,54 @@ class _Bundle:
         if self._compiled is None:
             self._compiled = self.lowered.compile()
         return self._compiled
+
+    def stacked_sharding(self):
+        """Sharding for a (K, batch, seq) window stack: the window axis is
+        unsharded (scan consumes it sequentially), each slice keeps the
+        bundle's batch sharding."""
+        return NamedSharding(
+            self.mesh, P(None, *tuple(self.batch_sharding.spec))
+        )
+
+    def has_fused(self, k: int) -> bool:
+        with self._fused_lock:
+            return int(k) in self._fused
+
+    def fused_compiled(self, k: int):
+        """AOT-compiled fused K-step program, compiled once per (bundle, K).
+
+        ``lax.scan`` of the raw train step over a stacked (K, batch, seq)
+        window inside one XLA program: one Python dispatch and one loss
+        readback amortize over K batches, and XLA pipelines the inter-step
+        boundary (no host round-trip between steps). State AND the window
+        stack are donated — the caller must stage a fresh stack per call.
+        The per-step losses come back as a (K,) vector so the loss
+        trajectory is observable exactly as the 1-step path reports it.
+        """
+        k = int(k)
+        with self._fused_lock:
+            hit = self._fused.get(k)
+        if hit is not None:
+            return hit
+        train = self.train_step
+        if train is None or k < 1:
+            raise ValueError(f"bundle cannot build a fused window (k={k})")
+
+        def multi_step(state, window):
+            return jax.lax.scan(train, state, window)
+
+        fused = jax.jit(
+            multi_step,
+            in_shardings=(self.state_shardings, self.stacked_sharding()),
+            out_shardings=(self.state_shardings, NamedSharding(self.mesh, P())),
+            donate_argnums=(0, 1),
+        )
+        window_sds = jax.ShapeDtypeStruct(
+            (k, *self.batch_sds.shape), self.batch_sds.dtype
+        )
+        compiled = fused.lower(self.state_shapes, window_sds).compile()
+        with self._fused_lock:
+            return self._fused.setdefault(k, compiled)
 
 
 class SPMDTechnique(BaseTechnique):
@@ -91,6 +201,16 @@ class SPMDTechnique(BaseTechnique):
     # sharded along the mesh. dp opts in; fsdp/tp shard params.
     fused_loss_shardable = False
 
+    # Advertises the optional ``execute(window_size=...)`` kwarg to the
+    # engine (``executor/engine.py`` gates the kwarg on this attribute so
+    # plugin techniques with the bare BaseTechnique signature keep working).
+    supports_windows = True
+    # Whether fused multi-step dispatch (``lax.scan`` window) is valid for
+    # this technique at all. Techniques whose step depends on per-call host
+    # interaction can opt out; offloaded (pinned_host) configs are excluded
+    # per-config in ``_fused_ok`` regardless.
+    fused_dispatch_ok = True
+
     def __init__(self) -> None:
         # Bundle cache keyed by (task, config, device block): the orchestrator
         # calls execute() every interval (reference kill-and-respawn,
@@ -101,7 +221,6 @@ class SPMDTechnique(BaseTechnique):
         # covers the compound move_to_end/popitem/del sequences: one technique
         # instance serves concurrent trial threads (``evaluator.py``) and
         # gang-launch threads (``engine.py``).
-        import threading
         from collections import OrderedDict
 
         self._bundles: "OrderedDict[Any, _Bundle]" = OrderedDict()
@@ -478,16 +597,25 @@ class SPMDTechnique(BaseTechnique):
             state_shardings=state_shardings,
             batch_sharding=batch_sharding,
             lowered=lowered,
+            train_step=train_step,
+            batch_sds=batch_sds,
         )
 
     # ------------------------------------------------------------ feasibility
     def _fits_memory(self, bundle: _Bundle, devices: Sequence[Any]) -> bool:
         """XLA compile-time memory check (replaces OOM probes,
         ``Spilled.py:68-87``)."""
+        return self._fits_compiled(bundle.compiled, devices)
+
+    def _fits_compiled(self, compiled: Any, devices: Sequence[Any]) -> bool:
+        """Memory check against a specific compiled program — the fused
+        K-step trial analyzes the window program it will actually time (its
+        peak includes the (K, B, T) staged stack the 1-step program never
+        holds)."""
         limit = device_hbm_bytes(devices[0])
         if limit <= 0:
             return True  # platform doesn't report limits (CPU tests)
-        need = hbm_bytes_required(bundle.compiled)
+        need = hbm_bytes_required(compiled)
         ok = need == 0 or need <= 0.92 * limit
         if not ok:
             log.info(
@@ -495,6 +623,16 @@ class SPMDTechnique(BaseTechnique):
                 self.name, need / 2**30, limit / 2**30,
             )
         return ok
+
+    def _fused_ok(self, config: Dict[str, Any]) -> bool:
+        """Whether THIS config may run fused windows. Pinned-host configs
+        stay per-step: their step interleaves host/device memory-space moves
+        (``compute_on``) that a scanned program would fold into one XLA
+        program holding all K staged batches plus the host round-trips —
+        exactly the residency the offload technique exists to avoid."""
+        return bool(self.fused_dispatch_ok) and (
+            self.param_memory_kind(config) != "pinned_host"
+        )
 
     # ---------------------------------------------------------------- search
     def search(
@@ -529,10 +667,40 @@ class SPMDTechnique(BaseTechnique):
                 }
         return best
 
+    def _profile_window(self, config: Dict[str, Any]) -> int:
+        """K the trial should profile: steady-state execute() runs full
+        windows of the max size, so that is what the MILP's per-batch times
+        must measure — not the per-step program fused dispatch retired."""
+        return max_window() if self._fused_ok(config) else 1
+
     def _try_config(
         self, task: Any, devices: Sequence[Any], config: Dict[str, Any]
     ) -> Optional[float]:
         bundle = self.build(task, devices, config)
+        k = self._profile_window(config)
+        if k > 1:
+            # Profile the fused window program execute() dispatches at
+            # steady state. Memory-check the SAME program (its peak holds
+            # the (K, B, T) stack); pre-staged, per-call-fresh window stacks
+            # keep donation honest and transfer out of the timed region —
+            # at execute() time the prefetcher overlaps staging with
+            # compute, so a trial that timed staging would overestimate.
+            fused = bundle.fused_compiled(k)
+            if not self._fits_compiled(fused, devices):
+                return None
+            ds = task.get_dataset()
+            sharding = bundle.stacked_sharding()
+
+            def stage(j: int):
+                host = np.stack(
+                    [np.asarray(ds.batch(j * k + i)) for i in range(k)]
+                )
+                return jax.device_put(host, sharding)
+
+            state = bundle.init()
+            return time_fused_window(
+                fused, state, stage, k, n_timed=2, n_warmup=1
+            )
         if not self._fits_memory(bundle, devices):
             return None
         state = bundle.init()
@@ -548,7 +716,24 @@ class SPMDTechnique(BaseTechnique):
         devices: Sequence[Any],
         tid: int,
         override_batch_count: Optional[int] = None,
+        window_size: Optional[int] = None,
     ) -> None:
+        """Run one interval of ``n`` batches as an async step pipeline.
+
+        Dispatch shape: ``n // K`` fused K-step windows (one ``lax.scan``
+        program per window, single loss readback at interval end) followed
+        by an ``n % K`` per-step tail on the exact legacy 1-step program —
+        the same train step scanned vs called, so the loss trajectory is
+        bit-identical either way. Batch staging (numpy slice + device_put)
+        runs on a prefetch thread one unit ahead of the device, closing the
+        host/device bubble of the old step-at-a-time loop.
+
+        ``window_size``: the engine plumbs ``pick_window(n)`` here so K is
+        chosen from the interval batch budget; ``None`` chooses locally
+        (``choose_window``). K is forced to 1 for configs where fused
+        dispatch is invalid (``_fused_ok``) and for n < 2 — short intervals
+        never pay a window compile.
+        """
         config = dict(task.selected_strategy.params or {})
         bundle = self.build(task, devices, config)
         key = self._bundle_key(task, devices, config)
@@ -586,35 +771,81 @@ class SPMDTechnique(BaseTechnique):
         n = int(n)
 
         from saturn_tpu.core import distributed as _dist
+        from saturn_tpu.data.prefetch import DevicePrefetcher
 
         start = task.current_batch
-        loss = None
-        # Whether this bundle had already compiled before the interval: if
+
+        # -------- window plan: n_windows fused units + per-step tail units
+        k = choose_window(n) if window_size is None else int(window_size)
+        k = max(1, min(k, max(n, 1)))
+        if k > 1 and not self._fused_ok(config):
+            k = 1
+        n_windows = n // k if k > 1 else 0
+        # unit = (is_fused, batch offset within the interval)
+        units: List[Tuple[bool, int]] = [(True, w * k) for w in range(n_windows)]
+        units += [(False, j) for j in range(n_windows * k, n)]
+
+        # Whether the program the FIRST unit runs had already compiled: if
         # so, even an n==1 interval yields a clean compile-free sample (a
         # task forecast at one batch per interval must not be starved of
         # feedback forever — its wrong trial profile is exactly what the
         # feedback exists to fix).
-        was_warm = bundle._compiled is not None
-        t_all0 = _timeit.default_timer()
-        t_steady = t_all0
-        for i in range(n):
+        first_fused = bool(units) and units[0][0]
+        was_warm = (
+            bundle.has_fused(k) if first_fused else bundle._compiled is not None
+        )
+        # AOT-compile every program this interval needs BEFORE the clock
+        # starts — compile cost belongs to neither samples/sec nor the
+        # realized-feedback window (docs/parity.md, round 10).
+        fused_fn = bundle.fused_compiled(k) if n_windows else None
+        single_fn = (
+            bundle.compiled if any(not f for f, _ in units) else None
+        )
+        stacked_sharding = bundle.stacked_sharding() if n_windows else None
+
+        def stage(u: int):
+            fused_u, off = units[u]
+            if fused_u:
+                host = np.stack([
+                    np.asarray(task.batch_at(start + off + j)) for j in range(k)
+                ])
+                return _dist.put_global(host, stacked_sharding)
             # put_global == device_put single-process; on a multi-host
             # block each process's devices take their slice locally
-            batch = _dist.put_global(
-                task.batch_at(start + i), bundle.batch_sharding
+            return _dist.put_global(
+                task.batch_at(start + off), bundle.batch_sharding
             )
-            state, loss = bundle.compiled(state, batch)
-            if i == 0 and n > 1:
-                # The first step pays the one-time jit compile whenever this
-                # bundle wasn't pre-warmed by search (preset-strategy /
-                # multi-host flows, every re-solve that moves a task to a new
-                # block). Keep it out of the realized-feedback window: block
-                # on its result and restart the steady-state timer.
-                jax.block_until_ready(loss)
-                t_steady = _timeit.default_timer()
+
+        loss = None
+        t_all0 = _timeit.default_timer()
+        t_steady = t_all0
+        # Batch staging runs one unit ahead on the prefetch thread; the
+        # loop body only dispatches device programs.
+        prefetch = DevicePrefetcher(len(units), stage, depth=2)
+        try:
+            for u, dev_batch in enumerate(prefetch):
+                if units[u][0]:
+                    state, loss = fused_fn(state, dev_batch)  # loss: (K,)
+                else:
+                    state, loss = single_fn(state, dev_batch)
+                if u == 0 and len(units) > 1:
+                    # The first unit still pays one-time warmup (executable
+                    # load, constant transfer) plus the un-overlapped first
+                    # staging. Keep it out of the realized-feedback window:
+                    # block on its result and restart the steady-state timer.
+                    jax.block_until_ready(loss)
+                    t_steady = _timeit.default_timer()
+        finally:
+            # SimulatedKill is a BaseException: a killed interval must not
+            # leak a producer thread that keeps slicing batches from a task
+            # the harness is rolling back.
+            prefetch.close()
         if loss is not None:
-            # host read = reliable queue drain (see utils/timing.py note)
-            loss_val = _dist.host_scalar(loss)
+            # ONE host readback per interval — the reliable queue drain
+            # (see utils/timing.py note). A fused window's loss is the (K,)
+            # per-step trajectory; its last entry is the interval's final
+            # loss, identical to what the 1-step path would report.
+            loss_val = float(_dist.host_array(loss).reshape(-1)[-1])
             t_end = _timeit.default_timer()
             elapsed_all = t_end - t_all0
             bs = task.get_dataset().batch_size
@@ -622,17 +853,19 @@ class SPMDTechnique(BaseTechnique):
             # per-job samples/sec — the BASELINE.md per-job metric — and the
             # realized per-batch time (vs the profiled estimate forecast used)
             task.last_samples_per_sec = sps
-            if n > 1:
+            first_unit_batches = k if first_fused else 1
+            if len(units) > 1:
                 # feed the profiled-vs-realized loop from the steady-state
-                # window only (batches 2..n); a compile-dominated first
-                # interval would otherwise inflate the EWMA many-fold and
-                # propagate to every sibling strategy.
-                per_batch = (t_end - t_steady) / (n - 1)
+                # window only (units 2..); a warmup-dominated first unit
+                # would otherwise inflate the EWMA and propagate to every
+                # sibling strategy. Window-granular: the divisor is the
+                # batch count the timed units actually retired.
+                per_batch = (t_end - t_steady) / max(n - first_unit_batches, 1)
                 task.note_realized_per_batch(per_batch)
             else:
-                per_batch = elapsed_all
+                per_batch = elapsed_all / max(n, 1)
                 if was_warm:
-                    # single-batch interval on an already-compiled bundle:
+                    # single-unit interval on an already-compiled program:
                     # still a clean sample — without it a task scheduled one
                     # batch per interval never gets corrected.
                     task.note_realized_per_batch(per_batch)
@@ -641,10 +874,11 @@ class SPMDTechnique(BaseTechnique):
             _metrics.event(
                 "task_interval", task=task.name, technique=self.name,
                 batches=n, loss=loss_val, samples_per_sec=round(sps, 2),
-                per_batch_s=per_batch,
+                per_batch_s=per_batch, window=k, fused_windows=n_windows,
             )
-            log.info("task %s [%s]: ran %d batches, loss %.4f, %.1f samples/s",
-                     task.name, self.name, n, loss_val, sps)
+            log.info("task %s [%s]: ran %d batches (K=%d, %d fused windows), "
+                     "loss %.4f, %.1f samples/s",
+                     task.name, self.name, n, k, n_windows, loss_val, sps)
 
         # Full train-state checkpoint (params + opt state + step): fixes the
         # reference's dropped-optimizer wart (``FSDP.py:220``). The disk write

@@ -531,6 +531,10 @@ class SaturnService:
                     # checkpoint publications durable together.
                     jnl.commit()
                     jnl.barrier("post-checkpoint", interval=interval_index)
+                # Interval boundary for the buffered metrics writer: the
+                # JSONL tail CLI follows this file live, so each interval's
+                # events must land when its journal records do.
+                metrics.flush()
                 interval_index += 1
 
         # Clean shutdown only — a simulated kill unwinds past this (a real

@@ -243,6 +243,12 @@ def _search_inner(
     # cache is falsy — a bare truthiness test would fingerprint the first
     # run with a blank topology signature and never hit again.
     topo_sig = pcache.topology_signature(topo) if cache is not None else ""
+    # Trials profile whatever dispatch mode execute() will run (fused
+    # K-step windows vs per-step — ``SPMDTechnique._try_config``), so the
+    # mode is part of every cache key: a per-step profile recorded before
+    # fused dispatch landed (or with a different window cap) must MISS, not
+    # warm-start the sweep with numbers execution won't reproduce.
+    dispatch = pcache.dispatch_signature()
     for task in tasks:
         sizes = topo.valid_sizes()
         if task.chip_range is not None:
@@ -258,7 +264,9 @@ def _search_inner(
             lane = _Lane(task, name, tech, sizes)
             if task_sig is not None:
                 for g in lane.sizes:
-                    lane.keys[g] = pcache.fingerprint(task_sig, name, g, topo_sig)
+                    lane.keys[g] = pcache.fingerprint(
+                        task_sig, name, g, topo_sig, dispatch
+                    )
             lanes.append(lane)
 
     def install(lane: _Lane, g: int, params, per_batch: float, source: str) -> None:
@@ -532,4 +540,5 @@ def _search_inner(
         "cache_hits": n_hits,
         "pruned": eta.pruned,
         "interpolated": n_interp,
+        "dispatch": dispatch,
     }

@@ -24,33 +24,81 @@ logger = logging.getLogger("saturn_tpu")
 
 
 class MetricsWriter:
-    """Thread-safe JSONL appender (the engine launches tasks from threads)."""
+    """Thread-safe JSONL appender (the engine launches tasks from threads).
 
-    def __init__(self, path: str):
+    Events are buffered in memory and written in batches — size-bounded
+    (``max_buffered`` events) and time-bounded (``max_latency_s`` since the
+    oldest unwritten event) — so emission stays off the step critical path:
+    the old line-buffered stream paid a syscall + page-cache write per event
+    from inside interval hot loops. Hot-path callers just append under the
+    lock; the engine/orchestrator/service call :func:`flush` at interval
+    boundaries, and ``close()`` always drains.
+
+    Torn-tail guarantees are unchanged: each drain is a single ``write()``
+    of whole ``\\n``-terminated lines, so a crash can tear at most the last
+    line in flight — exactly what ``read_events``/``tail_events`` already
+    skip-and-warn on. What buffering *does* change is the loss window: a
+    crash between flushes drops the buffered (never-written) events, which
+    is why the durability journal — not metrics — is the ledger of record.
+    """
+
+    def __init__(self, path: str, max_buffered: int = 256,
+                 max_latency_s: float = 2.0):
         self.path = path
+        self.max_buffered = max(1, int(max_buffered))
+        self.max_latency_s = float(max_latency_s)
         self._lock = threading.Lock()
-        self._fh = open(path, "a", buffering=1)
+        self._fh = open(path, "a")
+        self._buf: list = []
+        self._oldest: Optional[float] = None  # monotonic ts of _buf[0]
 
     def event(self, kind: str, **fields) -> None:
         rec = {"ts": time.time(), "kind": kind}
         rec.update(fields)
         line = json.dumps(rec, default=str)
+        now = time.monotonic()
+        with self._lock:
+            if self._fh.closed:
+                # The module-level event() reads _WRITER without _CONF_LOCK,
+                # so a racing configure()/scoped() may close this writer
+                # between the read and this call. Dropping the event is fine;
+                # raising inside an engine launcher thread would record a
+                # spurious task failure.
+                return
+            self._buf.append(line)
+            if self._oldest is None:
+                self._oldest = now
+            if (len(self._buf) >= self.max_buffered
+                    or now - self._oldest >= self.max_latency_s):
+                self._drain_locked()
+
+    def _drain_locked(self) -> None:
+        if not self._buf or self._fh.closed:
+            self._buf = []
+            self._oldest = None
+            return
+        data = "\n".join(self._buf) + "\n"
+        self._buf = []
+        self._oldest = None
         try:
-            with self._lock:
-                self._fh.write(line + "\n")
-        except ValueError:
-            # The module-level event() reads _WRITER without _CONF_LOCK, so a
-            # racing configure()/scoped() may close this file between the read
-            # and the write. Dropping the event is fine; raising inside an
-            # engine launcher thread would record a spurious task failure.
+            self._fh.write(data)
+            self._fh.flush()
+        except (OSError, ValueError):
             pass
 
-    def close(self) -> None:
-        """Close the stream, fsyncing first: ``configure``/``scoped`` rotate
-        sinks by closing the old writer, so rotation is a durability point —
-        a crash right after must not lose the rotated-out events to the page
-        cache."""
+    def flush(self) -> None:
+        """Write out everything buffered (interval-boundary durability for
+        live ``tail_events`` followers and post-run ``read_events``)."""
         with self._lock:
+            self._drain_locked()
+
+    def close(self) -> None:
+        """Drain, then close the stream, fsyncing first: ``configure``/
+        ``scoped`` rotate sinks by closing the old writer, so rotation is a
+        durability point — a crash right after must not lose the rotated-out
+        events to the page cache."""
+        with self._lock:
+            self._drain_locked()
             if not self._fh.closed:
                 try:
                     self._fh.flush()
@@ -78,6 +126,16 @@ def event(kind: str, **fields) -> None:
     w = _WRITER
     if w is not None:
         w.event(kind, **fields)
+
+
+def flush() -> None:
+    """Drain the configured writer's buffer to disk; no-op when metrics are
+    off. Called at interval boundaries (engine, orchestrator, service loop)
+    so telemetry lands off the step critical path but before the next
+    interval's work starts."""
+    w = _WRITER
+    if w is not None:
+        w.flush()
 
 
 def read_events(path: str, kind: Optional[str] = None) -> list:

@@ -151,8 +151,31 @@ def topology_signature(topo: Any) -> str:
     return sig() if callable(sig) else repr(topo)
 
 
-def fingerprint(task_sig: str, technique: str, size: int, topo_sig: str) -> str:
-    """Cache key for one (task, technique, sub-mesh size) grid point."""
+def dispatch_signature() -> str:
+    """How execute() dispatches batches — part of every fingerprint.
+
+    Trials profile the dispatch mode execution will use (fused K-step scan
+    windows vs per-step calls), and the two modes have genuinely different
+    per-batch times — amortized dispatch/readback overhead is the point of
+    fusing. A stale per-step profile warm-starting a fused sweep (or vice
+    versa) would hand the MILP numbers execution never exhibits, so the
+    mode (and its window cap) keys the cache. Imported lazily: utils must
+    not import parallel at module level.
+    """
+    try:
+        from saturn_tpu.parallel.spmd_base import dispatch_signature as _ds
+
+        return _ds()
+    except Exception:
+        return "per-step"
+
+
+def fingerprint(
+    task_sig: str, technique: str, size: int, topo_sig: str,
+    dispatch: Optional[str] = None,
+) -> str:
+    """Cache key for one (task, technique, sub-mesh size) grid point under
+    one execution dispatch mode (``dispatch_signature()`` when None)."""
     try:
         import jax
 
@@ -167,6 +190,7 @@ def fingerprint(task_sig: str, technique: str, size: int, topo_sig: str) -> str:
             "size": int(size),
             "topology": topo_sig,
             "jax": jax_version,
+            "dispatch": dispatch_signature() if dispatch is None else dispatch,
         },
         sort_keys=True,
     )

@@ -43,6 +43,35 @@ def time_train_step(
     return (timeit.default_timer() - t0) / n_timed
 
 
+def time_fused_window(
+    fused: Callable, state, stage: Callable[[int], object], k: int,
+    n_timed: int = 2, n_warmup: int = 1,
+) -> float:
+    """Mean seconds per BATCH for a fused K-step window program.
+
+    ``stage(j)`` must return a FRESH device-staged (K, ...) window stack for
+    call ``j``: the window program donates its batch buffers too, so a stack
+    can be offered exactly once (same never-reuse rule as the carry above).
+
+    All stacks are staged BEFORE the timed region. At execute() time the
+    prefetcher overlaps staging with compute, so the trial must measure the
+    device program alone — timing the transfers would hand the MILP
+    per-batch numbers execute() never exhibits. Requires ``n_warmup >= 1``
+    (the warmup call doubles as the compile + sync fence).
+    """
+    if n_warmup < 1:
+        raise ValueError("time_fused_window needs n_warmup >= 1")
+    windows = [stage(j) for j in range(n_warmup + n_timed)]
+    for j in range(n_warmup):
+        state, aux = fused(state, windows[j])
+    jax.device_get(aux)
+    t0 = timeit.default_timer()
+    for j in range(n_warmup, n_warmup + n_timed):
+        state, aux = fused(state, windows[j])
+    jax.device_get(aux)
+    return (timeit.default_timer() - t0) / (n_timed * k)
+
+
 def hbm_bytes_required(compiled) -> int:
     """Peak HBM bytes from XLA's compile-time memory analysis.
 

@@ -83,6 +83,89 @@ class TestMetrics:
         assert got[1]["x"] == 1
 
 
+class TestBufferedMetrics:
+    """Round 10: emission is buffered off the hot path — events hit disk in
+    batches at size/latency thresholds or an explicit interval-boundary
+    flush, and the whole-line torn-tail contract survives batching."""
+
+    def test_events_buffer_until_flush(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        w = metrics.MetricsWriter(p, max_buffered=256, max_latency_s=3600.0)
+        try:
+            for i in range(10):
+                w.event("step", i=i)
+            assert read_events(p) == []  # nothing written yet: no syscalls
+            w.flush()
+            evs = read_events(p)
+            assert [e["i"] for e in evs] == list(range(10))
+        finally:
+            w.close()
+
+    def test_size_threshold_auto_drains(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        w = metrics.MetricsWriter(p, max_buffered=4, max_latency_s=3600.0)
+        try:
+            for i in range(3):
+                w.event("step", i=i)
+            assert read_events(p) == []
+            w.event("step", i=3)  # 4th event crosses max_buffered
+            assert len(read_events(p)) == 4
+        finally:
+            w.close()
+
+    def test_latency_threshold_auto_drains(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "m.jsonl")
+        w = metrics.MetricsWriter(p, max_buffered=10_000, max_latency_s=2.0)
+        clock = [100.0]
+        monkeypatch.setattr(metrics.time, "monotonic", lambda: clock[0])
+        try:
+            w.event("a")
+            clock[0] += 1.0
+            w.event("b")
+            assert read_events(p) == []  # oldest is 1s old: under the bound
+            clock[0] += 1.5
+            w.event("c")  # oldest now 2.5s old: time-bounded drain
+            assert [e["kind"] for e in read_events(p)] == ["a", "b", "c"]
+        finally:
+            w.close()
+
+    def test_close_drains_buffer(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        w = metrics.MetricsWriter(p, max_buffered=256, max_latency_s=3600.0)
+        w.event("last", x=1)
+        w.close()
+        assert read_events(p)[0]["x"] == 1
+
+    def test_batched_drain_writes_whole_lines(self, tmp_path):
+        """One write() per drain, every line newline-terminated — the
+        guarantee read_events/tail_events' torn-tail handling relies on."""
+        p = str(tmp_path / "m.jsonl")
+        w = metrics.MetricsWriter(p, max_buffered=256, max_latency_s=3600.0)
+        try:
+            for i in range(5):
+                w.event("step", i=i)
+            w.flush()
+            with open(p) as f:
+                raw = f.read()
+            assert raw.endswith("\n")
+            assert len(raw.strip().splitlines()) == 5
+        finally:
+            w.close()
+
+    def test_module_flush_noop_when_unconfigured(self):
+        metrics.flush()  # must not raise with no writer configured
+
+    def test_module_flush_drains_global_writer(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        metrics.configure(p)
+        try:
+            metrics.event("interval", n=1)
+            metrics.flush()
+            assert read_events(p)[0]["kind"] == "interval"
+        finally:
+            metrics.configure(None)
+
+
 class TestTopLevelAPI:
     def test_orchestrate_signature_parity(self):
         # The top-level wrapper must forward every orchestrator kwarg

@@ -1,0 +1,196 @@
+"""Unit tests for bench.py's probe sentinel and timeout short-circuit.
+
+bench.py lives at the repo root (not in the package) so it is loaded via
+importlib; its module level only imports stdlib, so this is cheap — the
+heavy jax imports are inside main() and never run here.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    sentinel = tmp_path / "probe.json"
+    monkeypatch.setattr(mod, "_probe_sentinel_path", lambda: str(sentinel))
+    monkeypatch.setattr(mod, "_boot_key", lambda: "boot-A")
+    monkeypatch.delenv("SATURN_BENCH_PROBE_CACHE", raising=False)
+    monkeypatch.delenv("SATURN_BENCH_PROBE_TTL", raising=False)
+    return mod
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestProbeSentinel:
+    def test_hit_within_ttl_and_miss_after(self, bench, monkeypatch):
+        clock = FakeClock()
+        monkeypatch.setattr(bench.time, "time", clock)
+        bench._store_probe("tpu")
+        assert bench._cached_probe() == ("tpu",)
+        clock.t += bench._PROBE_TTL_S - 1
+        assert bench._cached_probe() == ("tpu",)
+        clock.t += 2  # past the TTL: tunnels do recover, re-probe
+        assert bench._cached_probe() is None
+
+    def test_negative_age_is_a_miss(self, bench, monkeypatch):
+        # A sentinel stamped in the future (clock skew) must not be trusted.
+        clock = FakeClock()
+        monkeypatch.setattr(bench.time, "time", clock)
+        bench._store_probe(None)
+        clock.t -= 10
+        assert bench._cached_probe() is None
+
+    def test_boot_key_mismatch_is_a_miss(self, bench, monkeypatch):
+        clock = FakeClock()
+        monkeypatch.setattr(bench.time, "time", clock)
+        bench._store_probe("cpu")
+        monkeypatch.setattr(bench, "_boot_key", lambda: "boot-B")
+        assert bench._cached_probe() is None
+
+    def test_cache_disable_env(self, bench, monkeypatch):
+        monkeypatch.setattr(bench.time, "time", FakeClock())
+        bench._store_probe("tpu")
+        monkeypatch.setenv("SATURN_BENCH_PROBE_CACHE", "0")
+        assert bench._cached_probe() is None
+
+    def test_store_records_none_platform(self, bench, monkeypatch):
+        monkeypatch.setattr(bench.time, "time", FakeClock())
+        bench._store_probe(None)
+        assert bench._cached_probe() == (None,)
+
+
+class TestProbeTimeoutShortCircuit:
+    def test_timeout_stops_retry_loop(self, bench, monkeypatch):
+        """A probe that burns its full timeout is a wedged tunnel: the retry
+        budget must NOT be spent on it (BENCH_r05 paid 2 x 75 s doing so),
+        and the failure must land in the sentinel immediately so the next
+        run in this session skips the probe entirely."""
+        clock = FakeClock()
+        monkeypatch.setattr(bench.time, "time", clock)
+        calls = []
+
+        def fake_run(cmd, **kw):
+            calls.append(cmd)
+            raise subprocess.TimeoutExpired(cmd=cmd, timeout=kw.get("timeout"))
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        sleeps = []
+        monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+
+        assert bench._probe_backend(timeout_s=75.0, retries=3) is None
+        assert len(calls) == 1  # short-circuited: no retries after a timeout
+        assert sleeps == []
+        # Sentinel recorded the failure inline, not just at main()'s store.
+        assert bench._cached_probe() == (None,)
+
+    def test_fast_failure_still_retries(self, bench, monkeypatch):
+        """rc != 0 failures are genuinely transient (UNAVAILABLE through the
+        tunnel, BENCH_r01) and keep the retry budget."""
+        monkeypatch.setattr(bench.time, "time", FakeClock())
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        calls = []
+
+        def fake_run(cmd, **kw):
+            calls.append(cmd)
+
+            class R:
+                returncode = 1
+                stdout = ""
+                stderr = "UNAVAILABLE: tunnel"
+
+            return R()
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        assert bench._probe_backend(timeout_s=75.0, retries=2) is None
+        assert len(calls) == 3  # initial + 2 retries
+        # A fast failure does NOT write the sentinel from inside the probe
+        # (main() records the final outcome once).
+        assert bench._cached_probe() is None
+
+    def test_success_returns_platform(self, bench, monkeypatch):
+        monkeypatch.setattr(bench.time, "time", FakeClock())
+
+        def fake_run(cmd, **kw):
+            class R:
+                returncode = 0
+                stdout = "PLATFORM=tpu\n"
+                stderr = ""
+
+            return R()
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        assert bench._probe_backend() == "tpu"
+
+
+class TestBenchGuard:
+    @pytest.fixture()
+    def guard(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench_guard_under_test",
+            os.path.join(REPO, "benchmarks", "bench_guard.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _write_record(self, root, n, parsed):
+        with open(os.path.join(root, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump({"n": n, "rc": 0, "parsed": parsed}, f)
+
+    def test_latest_record_picks_highest_round(self, guard, tmp_path, monkeypatch):
+        monkeypatch.setattr(guard, "REPO", str(tmp_path))
+        self._write_record(tmp_path, 3, {"value": 30.0, "platform": "cpu"})
+        self._write_record(tmp_path, 5, {"value": 48.2, "platform": "cpu"})
+        n, parsed = guard.latest_record()
+        assert n == 5 and parsed["value"] == 48.2
+
+    def test_latest_record_skips_unparsed(self, guard, tmp_path, monkeypatch):
+        monkeypatch.setattr(guard, "REPO", str(tmp_path))
+        self._write_record(tmp_path, 3, {"value": 30.0, "platform": "cpu"})
+        with open(tmp_path / "BENCH_r07.json", "w") as f:
+            json.dump({"n": 7, "rc": 124, "parsed": None}, f)
+        n, _ = guard.latest_record()
+        assert n == 3
+
+    def test_regression_and_ok_verdicts(self, guard, tmp_path, monkeypatch, capsys):
+        monkeypatch.setattr(guard, "REPO", str(tmp_path))
+        shape = {"platform": "cpu", "batch_size": 2, "seq_len": 256}
+        self._write_record(tmp_path, 5, {"value": 50.0, **shape})
+
+        monkeypatch.setattr(guard, "run_bench", lambda: {"value": 44.0, **shape})
+        assert guard.main() == 1  # 12% down: regression
+        assert json.loads(capsys.readouterr().out)["status"] == "regression"
+
+        monkeypatch.setattr(guard, "run_bench", lambda: {"value": 46.0, **shape})
+        assert guard.main() == 0  # 8% down: within the 10% band
+        assert json.loads(capsys.readouterr().out)["status"] == "ok"
+
+    def test_shape_mismatch_skips(self, guard, tmp_path, monkeypatch, capsys):
+        monkeypatch.setattr(guard, "REPO", str(tmp_path))
+        self._write_record(
+            tmp_path, 5,
+            {"value": 50.0, "platform": "cpu", "batch_size": 2, "seq_len": 256},
+        )
+        monkeypatch.setattr(
+            guard, "run_bench", lambda: {"value": 9000.0, "platform": "tpu"}
+        )
+        assert guard.main() == 0
+        assert json.loads(capsys.readouterr().out)["status"] == "skipped"

@@ -184,6 +184,112 @@ class NotingTech(RecordingTech):
         task.note_realized_per_batch(self.per_batch)
 
 
+class WindowedTech(RecordingTech):
+    """A technique advertising the round-10 fused-window execute contract."""
+
+    supports_windows = True
+
+    def __init__(self, per_batch=0.001, fail_on_window=None):
+        super().__init__(per_batch)
+        self.fail_on_window = fail_on_window
+
+    def execute(self, task, devices, tid, override_batch_count=None,
+                window_size=None):
+        from saturn_tpu.resilience.faults import PreemptedError
+
+        n = override_batch_count or 1
+        k = max(1, int(window_size or 1))
+        # Window-granular dispatch loop: a preemption mid-interval leaves
+        # whole windows retired but NO durable progress (no checkpoint) —
+        # exactly what SPMDTechnique.execute does.
+        for w in range((n + k - 1) // k):
+            if self.fail_on_window == w:
+                raise PreemptedError(f"chips revoked in window {w}")
+            time.sleep(self.per_batch * min(k, n - w * k))
+        with self.lock:
+            self.calls.append(
+                (task.name, len(devices), override_batch_count, window_size)
+            )
+
+
+class TestWindowPlumbing:
+    """Round 10: the engine picks K from the interval batch budget and
+    passes it only to techniques that advertise the windowed contract."""
+
+    def test_pick_window_follows_budget_and_cap(self, monkeypatch):
+        monkeypatch.setenv("SATURN_TPU_MAX_WINDOW", "4")
+        assert engine.pick_window(100) == 4
+        assert engine.pick_window(3) == 3
+        assert engine.pick_window(1) == 1
+
+    def test_execute_kwargs_gated_on_supports_windows(self):
+        assert engine._execute_kwargs(RecordingTech(), 16) == {}
+        kw = engine._execute_kwargs(WindowedTech(), 16)
+        assert kw == {"window_size": engine.pick_window(16)}
+
+    def test_engine_passes_window_size_to_windowed_tech(self, monkeypatch):
+        monkeypatch.setenv("SATURN_TPU_MAX_WINDOW", "4")
+        tech = WindowedTech(per_batch=0.001)
+        t = FakeTask("a", 10, [4], tech, pbt=1.0)
+        plan = solve([t], topo(8))
+        run, batches, _ = engine.forecast([t], 100.0, plan)
+        engine.execute(run, batches, 100.0, plan, topo(8))
+        (_, _, n, window) = tech.calls[0]
+        assert window == engine.pick_window(n)
+
+    def test_bare_signature_tech_still_runs(self):
+        """RecordingTech has the pre-round-10 execute signature — the engine
+        must not pass it the window kwarg (plugin compatibility)."""
+        tech = RecordingTech()
+        t = FakeTask("a", 5, [4], tech, pbt=1.0)
+        plan = solve([t], topo(8))
+        run, batches, _ = engine.forecast([t], 100.0, plan)
+        engine.execute(run, batches, 100.0, plan, topo(8))
+        assert len(tech.calls) == 1
+
+
+class TestWindowGranularRollback:
+    """rollback_forecast with the fused window pipeline (satellite of round
+    10): an interval preempted MID-WINDOW is all-or-nothing — the rollback
+    must restore the batch budget and every strategy runtime to exactly the
+    pre-forecast values, with no partial-window credit."""
+
+    def test_midwindow_preemption_restores_budget_exactly(self):
+        tech = WindowedTech(per_batch=0.0, fail_on_window=1)
+        t = FakeTask("a", total_batches=10, sizes=[2, 4], tech=tech, pbt=1.0)
+        before_budget = t.total_batches
+        before_runtimes = {g: s.runtime for g, s in t.strategies.items()}
+
+        plan = solve([t], topo(8), ordering_slack=0.0)
+        run, batches, _ = engine.forecast([t], interval=100.0, plan=plan)
+        assert t.total_batches == before_budget - batches["a"]  # pre-deducted
+
+        from saturn_tpu.resilience.faults import PreemptedError
+
+        # Preemption is NOT an error under the "raise" policy: the engine
+        # hands it back for the orchestrator's requeue path to roll back.
+        errors = engine.execute(run, batches, 100.0, plan, topo(8))
+        assert isinstance(errors["a"], PreemptedError)
+        assert not tech.calls  # window 1 died before the interval recorded
+
+        engine.rollback_forecast(t, batches["a"])
+        assert t.total_batches == before_budget
+        for g, s in t.strategies.items():
+            assert s.runtime == pytest.approx(before_runtimes[g])
+
+    def test_rollback_is_inverse_of_forecast_for_partial_interval(self):
+        """Forecast caps an interval below the remaining budget; rollback of
+        that partial deduction must also be exact."""
+        tech = WindowedTech(per_batch=0.0)
+        t = FakeTask("a", total_batches=100, sizes=[4], tech=tech, pbt=1.0)
+        plan = solve([t], topo(8))
+        run, batches, _ = engine.forecast([t], interval=50.0, plan=plan)
+        assert 0 < batches["a"] < 100
+        engine.rollback_forecast(t, batches["a"])
+        assert t.total_batches == 100
+        assert t.strategies[4].runtime == pytest.approx(100 * 1.0)
+
+
 class TestRaceGuard:
     """engine._check_disjoint: overlapping blocks without an ordering
     dependency must be refused before any program launches."""
