@@ -71,9 +71,10 @@ def run_bench() -> dict:
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (
-        parsed.get("platform"),
-        parsed.get("batch_size"),  # only present on degraded runs
-        parsed.get("seq_len"),
+        parsed.get("workload"),    # e.g. benchmarks/coschedule.py tags its
+        parsed.get("platform"),    # row "coschedule_pair"; bench.py rows
+        parsed.get("batch_size"),  # carry no tag — the two never gate each
+        parsed.get("seq_len"),     # other. batch_size: degraded runs only.
     )
 
 

@@ -62,6 +62,14 @@ class Strategy:
     # grid point (``utils/profile_cache.py``) — lets the orchestrator write
     # realized measurements back to the cache.
     cache_key: Optional[str] = field(default=None)
+    # Fraction of a steady-state batch spent on HOST work (staging, pinned
+    # host transfers) rather than device compute, in [0, 1]. Measured by the
+    # trial runner (``SPMDTechnique._try_config``); the solver's co-location
+    # term uses it to predict which job pairs can fill each other's bubbles
+    # when their windows interleave on a shared block. 0.0 (the default, and
+    # what pre-existing cache entries report) predicts no overlap win, so a
+    # strategy without a measurement is never co-scheduled.
+    host_fraction: float = field(default=0.0)
 
     def __post_init__(self) -> None:
         if self.apportionment < 1:

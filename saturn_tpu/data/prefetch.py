@@ -22,6 +22,11 @@ from typing import Any, Callable
 
 _POLL_S = 0.1
 
+#: Sentinel returned by :meth:`DevicePrefetcher.try_next` while the producer
+#: is still staging the next unit — distinct from any staged value and from
+#: exhaustion (which raises StopIteration like the iterator protocol does).
+NOT_READY = object()
+
 
 class DevicePrefetcher:
     """Iterate device-staged values produced by a background thread.
@@ -97,6 +102,33 @@ class DevicePrefetcher:
                         "prefetch producer thread died without posting a "
                         "result or an error"
                     )
+        if tag == "err":
+            self._taken = self.n
+            raise val
+        self._taken += 1
+        return val
+
+    def try_next(self):
+        """Non-blocking ``__next__``: the staged value when the producer has
+        it ready, :data:`NOT_READY` while staging is still in flight, and
+        StopIteration on exhaustion (same protocol as iteration). The
+        co-schedule shared launcher uses this so one member's slow host
+        staging never parks the launcher while another member has device
+        windows ready to dispatch — the interleave win depends on it."""
+        if self._taken >= self.n:
+            self.close()
+            raise StopIteration
+        try:
+            tag, val = self._q.get_nowait()
+        except queue.Empty:
+            if self._closed.is_set():
+                raise StopIteration
+            if not self._thread.is_alive():
+                raise RuntimeError(
+                    "prefetch producer thread died without posting a "
+                    "result or an error"
+                )
+            return NOT_READY
         if tag == "err":
             self._taken = self.n
             raise val

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time as _time
 import timeit
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -90,24 +91,61 @@ def rollback_forecast(task, n_batches: int) -> None:
             s.runtime = s.per_batch_time * task.total_batches
 
 
-def pick_window(n_batches: int) -> int:
+def pick_window(n_batches: int, cap: Optional[int] = None) -> int:
     """Fused multi-step window K for an interval batch budget — the engine
     side of the async step pipeline: K comes from the forecast's budget so
     the technique runs ``n // K`` fused windows plus an exact per-step tail.
-    Delegates to the technique layer's policy (``SATURN_TPU_MAX_WINDOW``
-    cap); imported lazily to keep executor -> parallel a call-time edge."""
+    Delegates to the technique layer's policy; imported lazily to keep
+    executor -> parallel a call-time edge.
+
+    ``cap`` is the window ceiling the caller resolved ONCE at interval start
+    (:func:`_window_cap`): ``execute`` passes it to every launcher so a
+    mid-run ``SATURN_TPU_MAX_WINDOW`` flip cannot split one interval across
+    two window policies. ``None`` re-reads the env (standalone callers)."""
     from saturn_tpu.parallel.spmd_base import choose_window
 
-    return choose_window(n_batches)
+    return choose_window(n_batches, cap=cap)
 
 
-def _execute_kwargs(tech, n_batches: int) -> Dict[str, int]:
+def _window_cap() -> int:
+    """Resolve the fused-window ceiling (env ``SATURN_TPU_MAX_WINDOW``) —
+    called exactly once per interval, at the top of ``execute``."""
+    from saturn_tpu.parallel.spmd_base import max_window
+
+    return max_window()
+
+
+def _execute_kwargs(tech, n_batches: int, cap: Optional[int] = None) -> Dict[str, int]:
     """The optional kwargs this technique's ``execute`` accepts. Gated on
     ``supports_windows`` so plugin techniques (and test fakes) with the bare
     ``BaseTechnique`` signature keep working unchanged."""
     if getattr(tech, "supports_windows", False):
-        return {"window_size": pick_window(n_batches)}
+        return {"window_size": pick_window(n_batches, cap)}
     return {}
+
+
+def _coschedule_find(run_tasks, plan):
+    """Union-find root function over the plan's co-schedule groups,
+    restricted to the launched tasks. Members of one group are one condensed
+    node: they run interleaved on one shared launcher, so ordering and race
+    properties are checked between groups, never inside one. Groups that
+    share a member merge (one launcher must own a task)."""
+    running = {t.name for t in run_tasks}
+    parent: Dict[str, str] = {n: n for n in running}
+
+    def find(n: str) -> str:
+        while parent[n] != n:
+            parent[n] = parent[parent[n]]  # path halving
+            n = parent[n]
+        return n
+
+    for grp in getattr(plan, "coschedule", None) or []:
+        members = [n for n in grp if n in running]
+        for a, b in zip(members, members[1:]):
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[ra] = rb
+    return find
 
 
 def _check_disjoint(run_tasks, plan) -> None:
@@ -118,38 +156,61 @@ def _check_disjoint(run_tasks, plan) -> None:
     on events that never fire (silent hang) — the engine refuses loudly
     instead (SURVEY §5 concurrency-safety: detection, not just avoidance).
 
-    - Two launched tasks may share devices only if the dependency graph
+    Checked on the CONDENSED graph whose nodes are co-schedule groups
+    (``plan.coschedule``) — a group's members intentionally share a block,
+    interleaved by one launcher, so the overlap rule applies between groups:
+
+    - Two launched nodes may share devices only if the dependency graph
       serializes them — TRANSITIVELY: the launcher's event-waits chain, so
-      a→b→c serializes (a, c) without a direct edge.
-    - The dependency graph restricted to launched tasks must be acyclic:
-      the launcher only waits on running tasks, and a cycle parks every
-      thread in it forever.
+      a→b→c serializes (a, c) without a direct edge — or if they are the
+      same co-schedule group.
+    - The condensed dependency graph restricted to launched tasks must be
+      acyclic: the launcher only waits on running tasks, and a cycle parks
+      every thread in it forever.
+    - A dependency edge INSIDE a group is refused: group members launch
+      together, so a member waiting on its groupmate's completion event
+      would deadlock the shared launcher.
     """
     running = {t.name for t in run_tasks}
-    deps = {
-        n: [d for d in plan.dependencies.get(n, ()) if d in running]
-        for n in running
-    }
+    find = _coschedule_find(run_tasks, plan)
 
-    # Reachability over the running-task dependency DAG; cycle check rides
+    cdeps: Dict[str, set] = {find(n): set() for n in running}
+    for n in running:
+        rn = find(n)
+        for d in plan.dependencies.get(n, ()):
+            if d not in running:
+                continue
+            rd = find(d)
+            if rd == rn:
+                if d != n:
+                    raise RuntimeError(
+                        f"plan makes co-scheduled task {n!r} depend on its "
+                        f"groupmate {d!r}: group members run interleaved on "
+                        "one launcher, so an intra-group completion wait "
+                        "would deadlock the group"
+                    )
+                continue
+            cdeps[rn].add(rd)
+
+    # Reachability over the condensed dependency DAG; cycle check rides
     # the same DFS (a node reaching itself).
     reach: Dict[str, set] = {}
 
-    def reachable(n: str) -> set:
-        if n in reach:
-            return reach[n]
-        reach[n] = set()  # placeholder breaks self-recursion on cycles
+    def reachable(r: str) -> set:
+        if r in reach:
+            return reach[r]
+        reach[r] = set()  # placeholder breaks self-recursion on cycles
         out = set()
-        for d in deps[n]:
+        for d in cdeps[r]:
             out.add(d)
             out |= reachable(d)
-        reach[n] = out
+        reach[r] = out
         return out
 
-    for n in running:
-        if n in reachable(n):
+    for r in cdeps:
+        if r in reachable(r):
             raise RuntimeError(
-                f"plan dependency cycle through task {n!r}: the gang "
+                f"plan dependency cycle through task {r!r}: the gang "
                 "launch would deadlock (every thread in the cycle waits "
                 "on another's completion event)"
             )
@@ -161,13 +222,28 @@ def _check_disjoint(run_tasks, plan) -> None:
         for n2, a2 in items[i + 1:]:
             if a2 is None or not a1.block.overlaps(a2.block):
                 continue
-            if n1 not in reachable(n2) and n2 not in reachable(n1):
+            r1, r2 = find(n1), find(n2)
+            if r1 == r2:
+                continue  # co-scheduled: the shared block is the point
+            if r1 not in reachable(r2) and r2 not in reachable(r1):
                 raise RuntimeError(
                     f"plan races tasks {n1!r} and {n2!r}: blocks "
                     f"[{a1.block.offset}:{a1.block.end}] and "
                     f"[{a2.block.offset}:{a2.block.end}] overlap with no "
-                    "ordering path between them"
+                    "ordering path or co-schedule edge between them"
                 )
+
+
+def _coschedule_groups(run_tasks, plan) -> List[List]:
+    """The co-schedule groups actually launching this interval: lists of
+    Task objects (>= 2 running members each), one shared launcher per list.
+    Tasks not in any group (or whose groupmates aren't running this
+    interval) launch on the normal per-task path."""
+    find = _coschedule_find(run_tasks, plan)
+    by_root: Dict[str, List] = {}
+    for t in run_tasks:
+        by_root.setdefault(find(t.name), []).append(t)
+    return [g for g in by_root.values() if len(g) >= 2]
 
 
 def execute(
@@ -230,6 +306,11 @@ def execute(
 
     from saturn_tpu.resilience.faults import PreemptedError
 
+    # Resolve the fused-window ceiling ONCE for the whole interval: every
+    # launcher below receives this cap, so a mid-run SATURN_TPU_MAX_WINDOW
+    # flip cannot split one interval across two window policies.
+    window_cap = _window_cap()
+
     events = {t.name: threading.Event() for t in run_tasks}
     running = {t.name for t in run_tasks}
     errors: Dict[str, BaseException] = {}
@@ -271,7 +352,7 @@ def execute(
             )
             t_run = timeit.default_timer()
             tech.execute(task, devices, tid, override_batch_count=n,
-                         **_execute_kwargs(tech, n))
+                         **_execute_kwargs(tech, n, window_cap))
             dt_run = timeit.default_timer() - t_run
             if didx and health.any_lost(didx):
                 # chips died under the run: the device state is gone, the
@@ -294,10 +375,236 @@ def execute(
         finally:
             events[task.name].set()
 
+    def group_launcher(members: List, tids: List[int]):
+        """One shared launcher for a co-schedule group.
+
+        Two-phase interleave: (1) round-robin the members' dispatch
+        generators, advancing each one window per visit — a member whose
+        batch staging isn't ready yields "waiting" and the launcher moves to
+        the next member, which is exactly how a stage-bound job's host
+        phases get filled by a compute-bound neighbor's device windows; (2)
+        once every member has enqueued all its device work ("drain"), resume
+        each past drain to run its blocking finalization (loss readback,
+        checkpoint). Completion events fire only at GROUP end: a dependent
+        of any member must wait for the whole group, since the members
+        share the block until the last one drains.
+
+        Each member's dispatch ORDER (and therefore its loss/checkpoint
+        trajectory) is identical to a solo run — only the wall-clock packing
+        between members changes. Per-member realized feedback comes from
+        attributing the group's wall time by profiled work share; a member
+        whose technique lacks generator support runs sequentially on this
+        same thread after the interleaved members (correct, unoverlapped).
+        """
+        names = {t.name for t in members}
+        active: List[Dict] = []
+        t_group0 = timeit.default_timer()
+        try:
+            for t in members:
+                for dep in plan.dependencies.get(t.name, ()):
+                    if dep in running and dep not in names:
+                        events[dep].wait()
+            for t, tid in zip(members, tids):
+                try:
+                    a = plan.assignments[t.name]
+                    devices = topology.block_devices(a.block)
+                    didx = (
+                        health.indices_of(devices) if health is not None else []
+                    )
+                    if faults is not None and faults.crashes(
+                        t.name, interval_index
+                    ):
+                        raise RuntimeError(
+                            f"injected transient trial crash for {t.name}"
+                        )
+                    if abort.is_set() or (didx and health.any_lost(didx)):
+                        raise PreemptedError(
+                            f"task {t.name} preempted before launch "
+                            f"(block [{a.block.offset}:{a.block.end}])"
+                        )
+                    t.select_strategy(a.apportionment)
+                    if on_task_start is not None:
+                        on_task_start(t.name)
+                    tech = t.selected_strategy.executor
+                    n = batches[t.name]
+                    pbt = max(
+                        getattr(t.selected_strategy, "per_batch_time", 0.0),
+                        1e-9,
+                    )
+                    can_interleave = getattr(
+                        tech, "supports_coschedule", False
+                    ) and hasattr(tech, "interval_dispatches")
+                    logger.info(
+                        "interval: co-launching %s on block [%d:%d] for %d "
+                        "batches (%s)", t.name, a.block.offset, a.block.end,
+                        n, "interleaved" if can_interleave else "sequential",
+                    )
+                    gen = (
+                        tech.interval_dispatches(
+                            t, devices, tid, override_batch_count=n,
+                            shared=True, **_execute_kwargs(tech, n, window_cap)
+                        )
+                        if can_interleave
+                        else None
+                    )
+                    active.append({
+                        "task": t, "tech": tech, "gen": gen, "tid": tid,
+                        "n": n, "pbt": pbt, "didx": didx, "devices": devices,
+                        "block": a.block, "per_batch": None,
+                        "interleaved": can_interleave,
+                    })
+                except BaseException as e:
+                    errors[t.name] = e
+                    if isinstance(e, PreemptedError):
+                        logger.warning("%s", e)
+                    else:
+                        logger.exception(
+                            "task %s failed during interval", t.name
+                        )
+
+            # Phase 1: interleave dispatches across the generator members.
+            pending = [m for m in active if m["gen"] is not None]
+            drained: List[Dict] = []
+            while pending:
+                progressed = False
+                for m in list(pending):
+                    try:
+                        tag, _ = next(m["gen"])
+                    except StopIteration:
+                        pending.remove(m)
+                        m["gen"] = None
+                        continue
+                    except BaseException as e:
+                        errors[m["task"].name] = e
+                        logger.exception(
+                            "task %s failed during interval", m["task"].name
+                        )
+                        pending.remove(m)
+                        m["gen"] = None
+                        continue
+                    if tag == "dispatched":
+                        progressed = True
+                    elif tag == "drain":
+                        pending.remove(m)
+                        drained.append(m)
+                        progressed = True
+                    # "waiting": fall through to the next member — the poll
+                    # retries on this member's next visit
+                if not progressed and pending:
+                    # every member is staging: nothing to dispatch — give the
+                    # staging threads the core instead of spinning
+                    _time.sleep(0.001)
+
+            # Phase 2: blocking finalizations (loss readback, checkpoint),
+            # only after ALL members' device work is enqueued.
+            for m in drained:
+                try:
+                    for _ in m["gen"]:
+                        pass
+                except BaseException as e:
+                    errors[m["task"].name] = e
+                    logger.exception(
+                        "task %s failed during interval", m["task"].name
+                    )
+                finally:
+                    m["gen"] = None
+
+            # Sequential fallback for members without generator support.
+            for m in active:
+                if m["interleaved"] or m["task"].name in errors:
+                    continue
+                try:
+                    t_solo = timeit.default_timer()
+                    m["tech"].execute(
+                        m["task"], m["devices"], m["tid"],
+                        override_batch_count=m["n"],
+                        **_execute_kwargs(m["tech"], m["n"], window_cap),
+                    )
+                    m["per_batch"] = (
+                        timeit.default_timer() - t_solo
+                    ) / max(m["n"], 1)
+                except BaseException as e:
+                    errors[m["task"].name] = e
+                    logger.exception(
+                        "task %s failed during interval", m["task"].name
+                    )
+
+            # Attribute the group's wall clock to the interleaved members by
+            # profiled work share: member i's attributed per-batch time is
+            # wall * (n_i * pbt_i / sum_j n_j * pbt_j) / n_i — the realized
+            # feedback the solver's next re-solve consumes. (Sequential
+            # fallback members measured their own wall time above.)
+            dt_group = timeit.default_timer() - t_group0
+            ok = [m for m in drained if m["task"].name not in errors]
+            denom = sum(m["n"] * m["pbt"] for m in ok)
+            for m in ok:
+                share = (
+                    m["n"] * m["pbt"] / denom if denom > 0 else 1.0 / len(ok)
+                )
+                m["per_batch"] = dt_group * share / max(m["n"], 1)
+                note = getattr(m["task"], "note_realized_per_batch", None)
+                if note is not None:
+                    note(m["per_batch"])
+
+            # Per-member post-run bookkeeping, mirroring the solo launcher.
+            for m in active:
+                name = m["task"].name
+                if name in errors or m["per_batch"] is None:
+                    continue
+                try:
+                    if m["didx"] and health.any_lost(m["didx"]):
+                        raise PreemptedError(
+                            f"task {name} lost devices mid-run (block "
+                            f"[{m['block'].offset}:{m['block'].end}])"
+                        )
+                    m["task"].reconfigure(m["n"])
+                    if m["didx"]:
+                        health.note_step(m["didx"], m["per_batch"])
+                    if on_task_done is not None:
+                        on_task_done(name, m["n"])
+                except BaseException as e:
+                    errors[name] = e
+                    if isinstance(e, PreemptedError):
+                        logger.warning("%s", e)
+                    else:
+                        logger.exception(
+                            "task %s failed during interval", name
+                        )
+        except BaseException as e:
+            for t in members:
+                errors.setdefault(t.name, e)
+            logger.exception(
+                "co-schedule group %s failed", sorted(names)
+            )
+        finally:
+            for m in active:
+                if m["gen"] is not None:
+                    try:
+                        m["gen"].close()
+                    except BaseException:
+                        logger.exception(
+                            "closing dispatch generator for %s failed",
+                            m["task"].name,
+                        )
+            for t in members:
+                events[t.name].set()
+
+    co_groups = _coschedule_groups(run_tasks, plan)
+    grouped = {t.name for g in co_groups for t in g}
+    tid_of = {t.name: i for i, t in enumerate(run_tasks)}
     t0 = timeit.default_timer()
     threads = [
         threading.Thread(target=launcher, args=(t, i), daemon=True, name=f"launch-{t.name}")
         for i, t in enumerate(run_tasks)
+        if t.name not in grouped
+    ] + [
+        threading.Thread(
+            target=group_launcher,
+            args=(g, [tid_of[t.name] for t in g]),
+            daemon=True,
+            name="colaunch-" + "+".join(t.name for t in g),
+        )
+        for g in co_groups
     ]
     for th in threads:
         th.start()
@@ -357,6 +664,11 @@ def _execute_multihost(
 
     from saturn_tpu.core import distributed
 
+    # Co-schedule groups are ignored here on purpose: cross-host intervals
+    # already serialize every task for deterministic program order, and
+    # sequential execution of a group is trajectory-identical (just
+    # unoverlapped). The single window-cap read per interval still applies.
+    window_cap = _window_cap()
     my_proc = jax.process_index()
     errors: Dict[str, BaseException] = {}
     ordered = sorted(
@@ -380,7 +692,7 @@ def _execute_multihost(
                 tech = task.selected_strategy.executor
                 tech.execute(
                     task, devices, tid, override_batch_count=n,
-                    **_execute_kwargs(tech, n)
+                    **_execute_kwargs(tech, n, window_cap)
                 )
             task.reconfigure(batches[task.name])
         except BaseException as e:

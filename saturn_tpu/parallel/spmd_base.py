@@ -88,6 +88,24 @@ def choose_window(n_batches: int, cap: Optional[int] = None) -> int:
     return min(cap, n)
 
 
+def _host_fraction(t_host: float, t_device: float) -> float:
+    """Fraction of one steady-state batch spent on host-side staging work.
+
+    ``t_host`` is the measured staging cost per batch (dataset slice +
+    ``device_put`` transfer), ``t_device`` the device-only per-batch time.
+    The ratio against their sum lands in [0, 1] with the useful pivot at
+    0.5: above it the job is stage-bound — its device sits idle
+    ``t_host - t_device`` out of every ``t_host`` of wall clock, which is
+    the bubble a co-scheduled compute-bound neighbor can fill.
+    """
+    t_host = max(0.0, float(t_host))
+    t_device = max(0.0, float(t_device))
+    total = t_host + t_device
+    if total <= 0.0:
+        return 0.0
+    return min(1.0, max(0.0, t_host / total))
+
+
 def dispatch_signature() -> str:
     """Content signature of the execution dispatch mode, for the profile
     cache key (``utils/profile_cache.fingerprint``): per-step trial profiles
@@ -114,13 +132,26 @@ class _Bundle:
     _fused: Dict[int, Any] = field(default_factory=dict)
     _fused_lock: Any = field(default_factory=threading.Lock)
 
+    def _block_devices(self):
+        """The concrete devices this bundle's programs are pinned to — part
+        of every AOT-cache key (same program, different block = different
+        executable)."""
+        return list(self.mesh.devices.flat)
+
     @property
     def compiled(self):
         """The AOT-compiled train step. Compiled exactly once per bundle —
         memory analysis, trial timing and interval execution all share it, so
-        a (task, config, block) combination never compiles twice."""
+        a (task, config, block) combination never compiles twice. Routed
+        through the persistent executable cache (``utils/aot_cache``): a
+        restart or re-admission of a previously-seen program deserializes
+        instead of recompiling."""
         if self._compiled is None:
-            self._compiled = self.lowered.compile()
+            from saturn_tpu.utils import aot_cache
+
+            self._compiled = aot_cache.load_or_compile(
+                self.lowered, self._block_devices()
+            )
         return self._compiled
 
     def stacked_sharding(self):
@@ -167,7 +198,11 @@ class _Bundle:
         window_sds = jax.ShapeDtypeStruct(
             (k, *self.batch_sds.shape), self.batch_sds.dtype
         )
-        compiled = fused.lower(self.state_shapes, window_sds).compile()
+        from saturn_tpu.utils import aot_cache
+
+        compiled = aot_cache.load_or_compile(
+            fused.lower(self.state_shapes, window_sds), self._block_devices()
+        )
         with self._fused_lock:
             return self._fused.setdefault(k, compiled)
 
@@ -205,6 +240,11 @@ class SPMDTechnique(BaseTechnique):
     # engine (``executor/engine.py`` gates the kwarg on this attribute so
     # plugin techniques with the bare BaseTechnique signature keep working).
     supports_windows = True
+    # Advertises ``interval_dispatches`` — the resumable per-window generator
+    # the engine's co-schedule group launcher interleaves across tasks
+    # sharing a device block. Techniques without it fall back to sequential
+    # execution on the shared launcher (correct, just unoverlapped).
+    supports_coschedule = True
     # Whether fused multi-step dispatch (``lax.scan`` window) is valid for
     # this technique at all. Techniques whose step depends on per-call host
     # interaction can opt out; offloaded (pinned_host) configs are excluded
@@ -229,6 +269,10 @@ class SPMDTechnique(BaseTechnique):
         # popped) by the trial runner's monotone pruning. Keyed per grid
         # point because one instance serves concurrent trial threads.
         self._search_reports: Dict[Any, Dict[str, Any]] = {}
+        # Host fraction measured for the best (task, size) config — consumed
+        # (popped) by the trial runner alongside the per-batch time; feeds
+        # the solver's co-location term via ``Strategy.host_fraction``.
+        self._host_fracs: Dict[Any, float] = {}
         self._reports_lock = threading.Lock()
 
     def search_report(self, task_name: str, size: int) -> Optional[Dict[str, Any]]:
@@ -236,6 +280,14 @@ class SPMDTechnique(BaseTechnique):
         (task, size); None when the search was feasible or never ran."""
         with self._reports_lock:
             return self._search_reports.pop((task_name, size), None)
+
+    def host_fraction_report(self, task_name: str, size: int) -> Optional[float]:
+        """Pop the host fraction measured by the most recent feasible
+        ``search`` of (task, size); None when no feasible search ran. Same
+        pop-once protocol as ``search_report`` — one technique instance
+        serves concurrent trial threads."""
+        with self._reports_lock:
+            return self._host_fracs.pop((task_name, size), None)
 
     def release_task(self, task_name: str) -> None:
         """Drop every cached compiled program for ``task_name`` — called when
@@ -639,20 +691,26 @@ class SPMDTechnique(BaseTechnique):
         self, task: Any, devices: Sequence[Any], tid: int
     ) -> Tuple[Optional[Dict[str, Any]], Optional[float]]:
         best: Tuple[Optional[Dict[str, Any]], Optional[float]] = (None, None)
+        best_hf = 0.0
         n_configs = n_memory = n_error = 0
         for config in self.candidate_configs(task, len(devices)):
             n_configs += 1
             try:
-                t = self._try_config(task, devices, config)
+                timed = self._try_config(task, devices, config)
             except Exception as e:  # infeasible configs must not kill the sweep
                 log.info("%s trial %s failed: %r", self.name, config, e)
                 n_error += 1
                 continue
-            if t is None:  # _try_config returns None only on the memory check
+            if timed is None:  # _try_config returns None only on the memory check
                 n_memory += 1
                 continue
+            t, hf = timed
             if best[1] is None or t < best[1]:
                 best = (dict(config), t)
+                best_hf = hf
+        if best[1] is not None:
+            with self._reports_lock:
+                self._host_fracs[(task.name, len(devices))] = best_hf
         if best[1] is None:
             # Memory is the binding constraint only when EVERY candidate was
             # rejected by XLA memory analysis — a mesh/divisibility error in
@@ -675,7 +733,18 @@ class SPMDTechnique(BaseTechnique):
 
     def _try_config(
         self, task: Any, devices: Sequence[Any], config: Dict[str, Any]
-    ) -> Optional[float]:
+    ) -> Optional[Tuple[float, float]]:
+        """(seconds/batch, host_fraction) for one config; None = over memory.
+
+        The host fraction — staging cost (dataset slice + ``device_put``)
+        relative to staging + device compute for one steady-state batch — is
+        what the solver's co-location term consumes: a stage-bound job
+        (fraction near 1) leaves the device idle most of the wall clock, so
+        a compute-bound neighbor's windows can fill the bubble. The timed
+        per-batch number stays device-only (the prefetcher hides staging at
+        execute() time); staging is measured separately, outside the timed
+        region.
+        """
         bundle = self.build(task, devices, config)
         k = self._profile_window(config)
         if k > 1:
@@ -698,16 +767,26 @@ class SPMDTechnique(BaseTechnique):
                 return jax.device_put(host, sharding)
 
             state = bundle.init()
-            return time_fused_window(
+            t = time_fused_window(
                 fused, state, stage, k, n_timed=2, n_warmup=1
             )
+            t0 = _timeit.default_timer()
+            probe = stage(0)
+            jax.block_until_ready(probe)
+            t_host = (_timeit.default_timer() - t0) / k
+            del probe
+            return t, _host_fraction(t_host, t)
         if not self._fits_memory(bundle, devices):
             return None
         state = bundle.init()
+        t0 = _timeit.default_timer()
         batch = jax.device_put(
             task.get_dataset().batch(0), bundle.batch_sharding
         )
-        return time_train_step(bundle.compiled, state, batch, n_timed=3, n_warmup=2)
+        jax.block_until_ready(batch)
+        t_host = _timeit.default_timer() - t0
+        t = time_train_step(bundle.compiled, state, batch, n_timed=3, n_warmup=2)
+        return t, _host_fraction(t_host, t)
 
     # --------------------------------------------------------------- execute
     def execute(
@@ -733,6 +812,49 @@ class SPMDTechnique(BaseTechnique):
         (``choose_window``). K is forced to 1 for configs where fused
         dispatch is invalid (``_fused_ok``) and for n < 2 — short intervals
         never pay a window compile.
+
+        Implemented as a full drain of ``interval_dispatches`` — the solo
+        path and the co-scheduled path run the identical per-unit dispatch
+        sequence, which is what makes the interleaved trajectory guarantee
+        a structural property rather than a test assertion.
+        """
+        for _ in self.interval_dispatches(
+            task, devices, tid,
+            override_batch_count=override_batch_count,
+            window_size=window_size,
+        ):
+            pass
+
+    def interval_dispatches(
+        self,
+        task: Any,
+        devices: Sequence[Any],
+        tid: int,
+        override_batch_count: Optional[int] = None,
+        window_size: Optional[int] = None,
+        shared: bool = False,
+    ):
+        """One interval as resumable per-window sub-dispatches (a generator).
+
+        Yield protocol, in order:
+
+        - ``("waiting", u)`` — shared mode only: unit ``u``'s staged batch is
+          not ready yet. The caller (the engine's co-schedule group launcher)
+          should dispatch another member's windows instead of parking here;
+          resuming retries the poll.
+        - ``("dispatched", u)`` — unit ``u``'s device program was enqueued
+          (dispatch is async; the device may still be running it).
+        - ``("drain", n_units)`` — every unit has been dispatched. Resuming
+          past this performs the blocking finalization (loss readback,
+          realized feedback, checkpoint write, live-state republish) and
+          ends the generator.
+
+        ``shared=True`` is co-schedule mode: staging is polled non-blockingly
+        (``DevicePrefetcher.try_next``), the first-unit warmup fence is
+        skipped, and per-task realized feedback / samples-per-sec are left to
+        the caller's group wall-time attribution — the device-side dispatch
+        ORDER is exactly the solo path's, so each member's loss/checkpoint
+        trajectory is bit-identical to running alone.
         """
         config = dict(task.selected_strategy.params or {})
         bundle = self.build(task, devices, config)
@@ -771,7 +893,7 @@ class SPMDTechnique(BaseTechnique):
         n = int(n)
 
         from saturn_tpu.core import distributed as _dist
-        from saturn_tpu.data.prefetch import DevicePrefetcher
+        from saturn_tpu.data.prefetch import NOT_READY, DevicePrefetcher
 
         start = task.current_batch
 
@@ -823,18 +945,40 @@ class SPMDTechnique(BaseTechnique):
         # loop body only dispatches device programs.
         prefetch = DevicePrefetcher(len(units), stage, depth=2)
         try:
-            for u, dev_batch in enumerate(prefetch):
+            u = 0
+            while u < len(units):
+                if shared:
+                    try:
+                        dev_batch = prefetch.try_next()
+                    except StopIteration:
+                        break
+                    if dev_batch is NOT_READY:
+                        yield ("waiting", u)
+                        continue
+                else:
+                    try:
+                        dev_batch = next(prefetch)
+                    except StopIteration:
+                        break
                 if units[u][0]:
                     state, loss = fused_fn(state, dev_batch)  # loss: (K,)
                 else:
                     state, loss = single_fn(state, dev_batch)
-                if u == 0 and len(units) > 1:
+                if u == 0 and len(units) > 1 and not shared:
                     # The first unit still pays one-time warmup (executable
                     # load, constant transfer) plus the un-overlapped first
                     # staging. Keep it out of the realized-feedback window:
                     # block on its result and restart the steady-state timer.
+                    # (Shared mode skips the fence — blocking here would
+                    # stall the group launcher; the group owns timing.)
                     jax.block_until_ready(loss)
                     t_steady = _timeit.default_timer()
+                yield ("dispatched", u)
+                u += 1
+            # All device work for this member is enqueued. The caller may
+            # resume other members before paying this member's blocking
+            # finalization below.
+            yield ("drain", len(units))
         finally:
             # SimulatedKill is a BaseException: a killed interval must not
             # leak a producer thread that keeps slicing batches from a task
@@ -850,11 +994,19 @@ class SPMDTechnique(BaseTechnique):
             elapsed_all = t_end - t_all0
             bs = task.get_dataset().batch_size
             sps = n * bs / max(elapsed_all, 1e-9)
-            # per-job samples/sec — the BASELINE.md per-job metric — and the
-            # realized per-batch time (vs the profiled estimate forecast used)
-            task.last_samples_per_sec = sps
             first_unit_batches = k if first_fused else 1
-            if len(units) > 1:
+            if shared:
+                # Co-scheduled: this member's wall clock includes the
+                # interleaved neighbors' device windows, so neither
+                # samples/sec nor realized per-batch feedback can be read
+                # off it here — the group launcher attributes the group's
+                # wall time across members (``engine.py``).
+                per_batch = elapsed_all / max(n, 1)
+            elif len(units) > 1:
+                # per-job samples/sec — the BASELINE.md per-job metric — and
+                # the realized per-batch time (vs the profiled estimate
+                # forecast used).
+                task.last_samples_per_sec = sps
                 # feed the profiled-vs-realized loop from the steady-state
                 # window only (units 2..); a warmup-dominated first unit
                 # would otherwise inflate the EWMA and propagate to every
@@ -863,6 +1015,7 @@ class SPMDTechnique(BaseTechnique):
                 per_batch = (t_end - t_steady) / max(n - first_unit_batches, 1)
                 task.note_realized_per_batch(per_batch)
             else:
+                task.last_samples_per_sec = sps
                 per_batch = elapsed_all / max(n, 1)
                 if was_warm:
                     # single-unit interval on an already-compiled program:
@@ -875,6 +1028,7 @@ class SPMDTechnique(BaseTechnique):
                 "task_interval", task=task.name, technique=self.name,
                 batches=n, loss=loss_val, samples_per_sec=round(sps, 2),
                 per_batch_s=per_batch, window=k, fused_windows=n_windows,
+                coscheduled=bool(shared),
             )
             log.info("task %s [%s]: ran %d batches (K=%d, %d fused windows), "
                      "loss %.4f, %.1f samples/s",

@@ -148,6 +148,21 @@ class Model:
             raise TypeError(f"expected Constraint, got {type(c)}")
         self.constraints.append(c)
 
+    def link_when(self, gate: Union[Var, Expr], a, b, big_m: float) -> None:
+        """Force ``a == b`` (up to tolerance) when the binary ``gate`` is 1.
+
+        Adds the big-M pair ``a - b <= M(1-gate)`` / ``b - a <= M(1-gate)``;
+        with gate=0 both rows relax away. The SPASE co-location term uses
+        this to pin a co-scheduled pair onto the identical (size, block)
+        option and an identical start time — the standard indicator-linking
+        idiom, kept here so the MILP builder stays declarative.
+        """
+        g = Expr.of(gate)
+        ea, eb = Expr.of(a), Expr.of(b)
+        slack = (Expr.of(1.0) - g) * float(big_m)
+        self.add(ea - eb <= slack)
+        self.add(eb - ea <= slack)
+
     def minimize(self, e: Expr) -> None:
         self._objective = Expr.of(e)
 

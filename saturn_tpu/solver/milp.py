@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from saturn_tpu.core.mesh import Block, SliceTopology
 from saturn_tpu.solver.lp import Expr, Model
@@ -52,15 +52,36 @@ class Plan:
     assignments: Dict[str, Assignment]          # task name -> slot
     makespan: float
     dependencies: Dict[str, List[str]] = field(default_factory=dict)
+    # Co-schedule groups: lists of task names whose windows the engine may
+    # INTERLEAVE on a shared device block instead of serializing them — the
+    # explicit co-location edge ``_check_disjoint`` honors. Produced by the
+    # MILP's co-location term when the measured host fractions predict that
+    # one job's host phases can hide under the other's device windows; empty
+    # everywhere else (warm/greedy/native plans are conservatively serial).
+    coschedule: List[List[str]] = field(default_factory=list)
+
+    def coschedule_group_of(self) -> Dict[str, int]:
+        """task name -> index of its co-schedule group (absent = solo)."""
+        out: Dict[str, int] = {}
+        for gi, grp in enumerate(self.coschedule):
+            for n in grp:
+                out[n] = gi
+        return out
 
     def compute_dependencies(self) -> None:
         """Edges between tasks whose blocks overlap: later start depends on
         earlier (reference builds deps from GPU-overlap ∩ boa,
-        ``milp.py:489-511``)."""
+        ``milp.py:489-511``). Members of one co-schedule group are exempt:
+        their overlap is the point — the engine interleaves them on a shared
+        launcher rather than ordering them."""
+        group_of = self.coschedule_group_of()
         deps: Dict[str, List[str]] = {name: [] for name in self.assignments}
         items = list(self.assignments.items())
         for i, (n1, a1) in enumerate(items):
             for n2, a2 in items[i + 1 :]:
+                g1, g2 = group_of.get(n1), group_of.get(n2)
+                if g1 is not None and g1 == g2:
+                    continue
                 if a1.block.overlaps(a2.block):
                     if a1.start <= a2.start:
                         deps[n2].append(n1)
@@ -107,6 +128,7 @@ class Plan:
                 for n, a in self.assignments.items()
             },
             "dependencies": self.dependencies,
+            "coschedule": [list(g) for g in self.coschedule],
         }
 
     @staticmethod
@@ -119,6 +141,8 @@ class Plan:
             },
             makespan=float(d["makespan"]),
             dependencies={k: list(v) for k, v in d["dependencies"].items()},
+            # absent in plans journaled before the co-schedule term existed
+            coschedule=[list(g) for g in d.get("coschedule", [])],
         )
 
 
@@ -238,6 +262,63 @@ def warm_schedule(
     return plan
 
 
+def _host_fraction_of(task, size: int) -> float:
+    """Measured host fraction of a task's strategy at ``size``, clamped to
+    [0, 1]. 0.0 when unmeasured (pre-existing cache entries, dummy
+    strategies) — which makes the predicted interleave gain 1.0x and keeps
+    the pair out of the co-location term entirely."""
+    strat = getattr(task, "strategies", {}).get(size)
+    if strat is None:
+        return 0.0
+    hf = float(getattr(strat, "host_fraction", 0.0) or 0.0)
+    return min(max(hf, 0.0), 1.0)
+
+
+def coschedule_candidates(
+    task_list: List,
+    choices: Dict[str, List[Tuple[int, "Block", float]]],
+    min_gain: float,
+) -> List[Tuple[str, str, List[Tuple[int, int, float]]]]:
+    """Task pairs whose measured host fractions predict an interleave win.
+
+    For each pair and each (size, block) option BOTH tasks could take, the
+    interleaved pair occupies the block for
+    ``comb = max(rt1, rt2, dev1 + dev2)`` where ``dev = (1 - host_fraction)
+    * rt`` — device phases serialize on the shared block, host phases hide
+    under the partner's device windows. The pair is a candidate only when
+    the best common option predicts ``(rt1 + rt2) / comb >= min_gain``: two
+    compute-bound jobs give ``comb = rt1 + rt2`` (gain 1.0x) and never
+    qualify, which is exactly the "choose co-location only when the host
+    fraction predicts a win" contract. Returns ``(n1, n2, [(i1, i2, comb),
+    ...])`` with option indices into each task's choice list.
+    """
+    by_name = {t.name: t for t in task_list}
+    names = [t.name for t in task_list]
+    out: List[Tuple[str, str, List[Tuple[int, int, float]]]] = []
+    for i, n1 in enumerate(names):
+        for n2 in names[i + 1 :]:
+            opt2 = {
+                (s, b.offset, b.size): (j, rt)
+                for j, (s, b, rt) in enumerate(choices[n2])
+            }
+            common: List[Tuple[int, int, float]] = []
+            best_gain = 0.0
+            for i1, (s, b, rt1) in enumerate(choices[n1]):
+                hit = opt2.get((s, b.offset, b.size))
+                if hit is None:
+                    continue
+                i2, rt2 = hit
+                hf1 = _host_fraction_of(by_name[n1], s)
+                hf2 = _host_fraction_of(by_name[n2], s)
+                comb = max(rt1, rt2, (1.0 - hf1) * rt1 + (1.0 - hf2) * rt2)
+                common.append((i1, i2, comb))
+                if comb > 1e-9:
+                    best_gain = max(best_gain, (rt1 + rt2) / comb)
+            if common and best_gain >= min_gain:
+                out.append((n1, n2, common))
+    return out
+
+
 def solve(
     task_list: List,
     topology: SliceTopology,
@@ -246,6 +327,7 @@ def solve(
     milp_task_limit: int = 12,
     warm: Optional[Plan] = None,
     weights: Optional[Dict[str, float]] = None,
+    coschedule_min_gain: float = 1.15,
 ) -> Plan:
     """Build and solve the joint strategy/placement/schedule MILP.
 
@@ -272,6 +354,12 @@ def solve(
     scaled to at most ~0.5% of the horizon so it can only reorder, never
     trade away meaningful makespan — minimizing batch makespan stays the
     primary objective (the paper's SPASE formulation).
+
+    ``coschedule_min_gain``: minimum predicted pair speedup (sequential
+    runtime sum over interleaved combined occupancy, from the trial runner's
+    measured host fractions) for a pair to enter the co-location term — see
+    :func:`coschedule_candidates`. Only the exact MILP proposes co-schedule
+    groups; the native/greedy/warm paths stay conservatively serial.
     """
     for t in task_list:
         if not t.feasible_strategies():
@@ -376,13 +464,70 @@ def solve(
                 e = e + xi
         return e
 
-    # makespan >= start + runtime of the selected option (``milp.py:170-177``)
+    names = [t.name for t in task_list]
+
+    # ------------------------------------------------------- co-location term
+    # For pairs whose measured host fractions predict an interleave win, a
+    # binary ``co`` lets the solver pack both jobs onto the SAME (size,
+    # block) option at the SAME start: their windows then interleave on one
+    # launcher (engine CoScheduleGroup) instead of serializing. When co=1:
+    # both tasks are pinned to a common option (identical choice), starts
+    # are tied, the pair's own ordering-exclusion rows relax away, and each
+    # member's EFFECTIVE runtime — what third parties on the block and the
+    # makespan see — rises to the pair's combined occupancy ``comb``
+    # (device phases serialize; host phases hide). Tasks without a measured
+    # host fraction produce no candidates, no binaries, no new rows.
+    co_pairs = coschedule_candidates(task_list, choices, coschedule_min_gain)
+    co_of: Dict[Tuple[str, str], Any] = {}
+    eff: Dict[str, Expr] = {n: runtime_expr(n) for n in names}
+    per_task_cos: Dict[str, List] = {}
+    for n1, n2, common in co_pairs:
+        co = m.binary(f"co_{n1}_{n2}")
+        co_of[(n1, n2)] = co
+        per_task_cos.setdefault(n1, []).append(co)
+        per_task_cos.setdefault(n2, []).append(co)
+        common1 = {i1 for i1, _, _ in common}
+        common2 = {i2 for _, i2, _ in common}
+        # co=1 restricts both tasks to their COMMON options...
+        for j, xi in enumerate(x[n1]):
+            if j not in common1:
+                m.add(Expr.of(xi) <= Expr.of(1.0) - co)
+        for j, xi in enumerate(x[n2]):
+            if j not in common2:
+                m.add(Expr.of(xi) <= Expr.of(1.0) - co)
+        # ...forces the identical choice, and ties the starts.
+        for i1, i2, _ in common:
+            m.link_when(co, x[n1][i1], x[n2][i2], 1.0)
+        m.link_when(co, sta[n1], sta[n2], M)
+    if per_task_cos:
+        for n, cos in per_task_cos.items():
+            # One co-partner per task: groups stay pairs, and the engine's
+            # shared launcher never has to merge transitively-linked chains.
+            if len(cos) > 1:
+                m.add(sum(cos[1:], Expr.of(cos[0])) <= 1)
+            ert = m.continuous(f"ert_{n}", lb=0.0, ub=M)
+            m.add(Expr.of(ert) >= runtime_expr(n))
+            eff[n] = Expr.of(ert)
+        for n1, n2, common in co_pairs:
+            co = co_of[(n1, n2)]
+            comb_expr = Expr()
+            for i1, _, comb in common:
+                comb_expr = comb_expr + x[n1][i1] * comb
+            # co=1 (choice pinned to a common option, sum of common x's = 1)
+            # makes comb_expr the selected option's combined occupancy.
+            m.add(eff[n1] >= comb_expr - (Expr.of(1.0) - co) * M)
+            m.add(eff[n2] >= comb_expr - (Expr.of(1.0) - co) * M)
+
+    # makespan >= start + effective runtime of the selected option
+    # (``milp.py:170-177``; eff == runtime for every non-co-scheduled task)
     for t in task_list:
-        m.add(makespan >= sta[t.name] + runtime_expr(t.name))
+        m.add(makespan >= sta[t.name] + eff[t.name])
 
     # Worker exclusion: tasks sharing any device must be fully ordered with no
-    # overlap in time (``milp.py:277-319``).
-    names = [t.name for t in task_list]
+    # overlap in time (``milp.py:277-319``) — unless their co-schedule binary
+    # is set, which relaxes BOTH rows (the pair overlaps by design, and a
+    # third task on the block is still excluded from the whole interleaved
+    # span via the pair members' effective runtimes).
     for i, n1 in enumerate(names):
         for n2 in names[i + 1 :]:
             # skip pairs that can never overlap (disjoint choice sets)
@@ -394,35 +539,63 @@ def solve(
             if not may_overlap:
                 continue
             boa = m.binary(f"boa_{n1}_{n2}")  # 1 => n1 before n2
+            co = co_of.get((n1, n2))
+            co_relax = Expr.of(co) * M if co is not None else Expr.of(0.0)
             for dev in range(topology.capacity):
                 o1, o2 = occ_expr(n1, dev), occ_expr(n2, dev)
                 # if both occupy dev and boa=1: sta2 >= sta1 + rt1
                 m.add(
                     sta[n2]
                     >= sta[n1]
-                    + runtime_expr(n1)
+                    + eff[n1]
                     + ordering_slack
                     - M * (1 - Expr.of(boa))
                     - M * (2 - o1 - o2)
+                    - co_relax
                 )
                 m.add(
                     sta[n1]
                     >= sta[n2]
-                    + runtime_expr(n2)
+                    + eff[n2]
                     + ordering_slack
                     - M * Expr.of(boa)
                     - M * (2 - o1 - o2)
+                    - co_relax
                 )
 
     # Valid inequality (area cut): the selected options' total work area
     # cannot exceed makespan × capacity. Redundant for integer solutions but
     # tightens the LP relaxation — the big-M ordering rows relax to nothing,
     # so without it HiGHS's dual bound starts near max-single-runtime.
+    # A co-scheduled pair's host phases consume no device area — the pair
+    # occupies ``comb * size``, not ``(rt1 + rt2) * size`` — so each pair
+    # gets a savings variable, active only when its co binary is (sav <= 0
+    # otherwise), bounded by the SELECTED common option's area saving.
     area = Expr()
     for t in task_list:
         for xi, (size, _, rt) in zip(x[t.name], choices[t.name]):
             area = area + xi * (size * rt)
+    for n1, n2, common in co_pairs:
+        co = co_of[(n1, n2)]
+        sav = m.continuous(f"sav_{n1}_{n2}", lb=0.0, ub=M * topology.capacity)
+        savings_expr = Expr()
+        for i1, i2, comb in common:
+            size, _, rt1 = choices[n1][i1]
+            _, _, rt2 = choices[n2][i2]
+            savings_expr = savings_expr + x[n1][i1] * (
+                max(0.0, rt1 + rt2 - comb) * size
+            )
+        m.add(Expr.of(sav) <= savings_expr)
+        m.add(Expr.of(sav) <= Expr.of(co) * (M * topology.capacity))
+        area = area - Expr.of(sav)
     m.add(makespan >= area * (1.0 / topology.capacity))
+
+    # Tiny pressure AGAINST co-location: among makespan-equal schedules
+    # (e.g. the pair also fits side-by-side on disjoint blocks) prefer the
+    # plain plan — interleaving should only engage when it buys wall-clock.
+    # Scaled to ~0.01% of the horizon per pair so it can never trade a real
+    # makespan win away.
+    co_term = sum((Expr.of(c) for c in co_of.values()), Expr()) * (1e-4 * T)
 
     if weights:
         # Priority pressure: weighted start times, normalized so the whole
@@ -434,10 +607,14 @@ def solve(
             wn = max(weights.get(n, 0.0), 0.0)
             if wn > 0.0:
                 wterm = wterm + sta[n] * (wn / wsum)
-        m.minimize(makespan + wterm * 5e-3)
+        m.minimize(makespan + wterm * 5e-3 + co_term)
     else:
         # Tiny pressure toward early starts (keeps solutions canonical).
-        m.minimize(makespan + sum((sta[n] for n in names), Expr()) * (1e-6 / max(len(names), 1)))
+        m.minimize(
+            makespan
+            + sum((sta[n] for n in names), Expr()) * (1e-6 / max(len(names), 1))
+            + co_term
+        )
 
     if incumbent is not None:
         # Incumbent cut (native and/or warm fix-and-optimize plan): feasible,
@@ -466,7 +643,13 @@ def solve(
             start=max(0.0, res.value(sta[t.name])),
             runtime=rt,
         )
-    plan = Plan(assignments=assignments, makespan=res.value(makespan))
+    groups = [
+        [n1, n2] for (n1, n2), co in co_of.items() if res.value(co) > 0.5
+    ]
+    plan = Plan(
+        assignments=assignments, makespan=res.value(makespan),
+        coschedule=groups,
+    )
     plan.compute_dependencies()
     return plan
 
@@ -637,6 +820,13 @@ def resolve(
             if n in cur_names
         },
         makespan=max(0.0, previous.makespan - interval),
+        # surviving co-schedule groups slide with the plan; a group whose
+        # partner finished degenerates below 2 members and is dropped
+        coschedule=[
+            kept
+            for grp in previous.coschedule
+            if len(kept := [n for n in grp if n in cur_names]) >= 2
+        ],
     )
     slid.compute_dependencies()
     if fresh.makespan < slid.makespan - threshold:

@@ -269,9 +269,16 @@ def _search_inner(
                     )
             lanes.append(lane)
 
-    def install(lane: _Lane, g: int, params, per_batch: float, source: str) -> None:
+    def install(
+        lane: _Lane, g: int, params, per_batch: float, source: str,
+        host_fraction: float = 0.0,
+    ) -> None:
         """Fastest feasible technique per size wins (``:101-115``) —
-        measured, cached and interpolated entries all compete."""
+        measured, cached and interpolated entries all compete.
+
+        ``host_fraction`` feeds the solver's co-location term; interpolated
+        entries pass the 0.0 default on purpose — a co-schedule decision
+        needs a measured staging/compute split, not a fitted guess."""
         total = per_batch * lane.task.total_batches  # reference ``:26``
         with update_lock:
             cur = lane.task.strategies.get(g)
@@ -284,6 +291,7 @@ def _search_inner(
                     per_batch_time=per_batch,
                     interpolated=(source == "interpolated"),
                     cache_key=lane.keys.get(g),
+                    host_fraction=float(host_fraction or 0.0),
                 )
 
     def note_memory_floor(lane: _Lane, g: int) -> None:
@@ -309,9 +317,12 @@ def _search_inner(
                 source=entry.get("source", "trial"),
             )
             if feasible:
+                hf = entry.get("host_fraction", 0.0)
+                hf = float(hf) if isinstance(hf, (int, float)) else 0.0
                 lane.done[g] = (True, entry["params"], entry["per_batch_time"],
                                 entry.get("source", "trial"))
-                install(lane, g, entry["params"], entry["per_batch_time"], "cache")
+                install(lane, g, entry["params"], entry["per_batch_time"],
+                        "cache", host_fraction=hf)
             else:
                 lane.done[g] = (False, None, None, entry.get("source", "trial"))
                 if entry.get("memory_infeasible"):
@@ -402,19 +413,29 @@ def _search_inner(
             logger.info("%s", eta.trial_done(dt))
             return
         total = per_batch_time * task.total_batches  # reference ``:26``
+        # The staging-vs-compute split the technique measured alongside the
+        # per-batch time (``SPMDTechnique.host_fraction_report``, pop-once);
+        # plain BaseTechnique plugins report nothing -> 0.0 -> never
+        # co-scheduled.
+        hf = 0.0
+        hf_reporter = getattr(tech, "host_fraction_report", None)
+        if callable(hf_reporter):
+            hf = hf_reporter(task.name, g) or 0.0
         metrics.event("trial", task=task.name, size=g, technique=name,
                       feasible=True, per_batch_s=per_batch_time,
-                      est_total_s=total, params=params)
+                      est_total_s=total, params=params,
+                      host_fraction=round(float(hf), 4))
         logger.info(
             "trial (%s, g=%d, %s): %.4fs/batch, est total %.1fs (trial took %.1fs)",
             task.name, g, name, per_batch_time, total, dt,
         )
         with update_lock:
             lane.done[g] = (True, params, per_batch_time, "trial")
-        install(lane, g, params, per_batch_time, "trial")
+        install(lane, g, params, per_batch_time, "trial", host_fraction=hf)
         if cache is not None:
             cache.put(lane.keys.get(g), technique=name, size=g, feasible=True,
-                      params=params, per_batch_time=per_batch_time)
+                      params=params, per_batch_time=per_batch_time,
+                      host_fraction=float(hf))
         logger.info("%s", eta.trial_done(dt))
 
     def prune_point(lane: _Lane, g: int, reason: str, planned: bool) -> None:

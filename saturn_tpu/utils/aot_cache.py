@@ -1,0 +1,220 @@
+"""On-disk cache of AOT-compiled XLA executables (serialized, reloadable).
+
+JAX's persistent *compilation* cache (``profile_cache.
+maybe_enable_persistent_compile_cache``) is opt-in here because a cache
+shared across execution contexts with different feature detection can load
+mismatched entries (see ``tests/conftest.py``). This module is the narrower,
+always-safe alternative for the programs saturn_tpu itself builds: each
+``jit(...).lower(...)`` result is keyed by a content hash of its OWN HLO
+text plus the runtime identity (jax version, backend, device kinds/count,
+machine), and the compiled executable is serialized with
+``jax.experimental.serialize_executable`` into a subdirectory of the
+persistent profile-cache directory. On restart — the recovery replay path,
+or an online admission re-building a previously-seen program — the
+executable is deserialized instead of recompiled, cutting the cold-start
+compile tax that dominates both paths.
+
+Every failure mode (missing file, pickle/deserialize error, device-set
+mismatch, API drift) degrades to a recompile, never an error: a wrong or
+unloadable entry costs exactly what not having the cache costs. Entries are
+plain pickle files in a local trusted cache directory — the same trust
+domain as the profile entries beside them; delete the directory to
+invalidate everything.
+
+Environment:
+
+- ``SATURN_TPU_AOT_CACHE=1`` forces the cache on, ``=0`` forces it off.
+  Unset, it is on for TPU backends and OFF on CPU: the conftest-documented
+  XLA:CPU hazard — AOT-loaded machine code from an execution context with
+  different CPU feature detection executes anyway ("machine type doesn't
+  match" is a warning, not an error) and silently wedges collective
+  programs — applies to serialized executables exactly as it does to the
+  persistent compilation cache, so CPU opts in per-context instead.
+- ``SATURN_TPU_PROFILE_CACHE=0`` (the global profile-cache kill switch)
+  disables it too, since it lives inside that directory.
+- ``SATURN_TPU_PROFILE_CACHE_DIR`` moves the root (the ``aot/`` subdir).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import platform
+import threading
+from typing import Any, Optional
+
+log = logging.getLogger("saturn_tpu")
+
+_ENV_TOGGLE = "SATURN_TPU_AOT_CACHE"
+_SUBDIR = "aot"
+
+#: Bump when the payload layout changes meaning — old entries then miss.
+SCHEMA_VERSION = 1
+
+_stats_lock = threading.Lock()
+_stats = {"hits": 0, "misses": 0, "stores": 0, "errors": 0}
+
+
+def stats() -> dict:
+    """Copy of the process-lifetime hit/miss counters (telemetry, tests)."""
+    with _stats_lock:
+        return dict(_stats)
+
+
+def _bump(k: str) -> None:
+    with _stats_lock:
+        _stats[k] += 1
+
+
+def enabled() -> bool:
+    from saturn_tpu.utils import profile_cache as _pc
+
+    raw = os.environ.get(_ENV_TOGGLE)
+    if raw is not None and raw.lower() in _pc._FALSEY:
+        return False
+    if raw is None:
+        # default: TPU only — see the module docstring's CPU hazard note
+        try:
+            import jax
+
+            if jax.default_backend() not in ("tpu",):
+                return False
+        except Exception:
+            return False
+    # riding inside the profile-cache directory means riding its kill switch
+    return _pc.default_cache() is not None
+
+
+def cache_dir() -> str:
+    from saturn_tpu.utils import profile_cache as _pc
+
+    return os.path.join(_pc.default_dir(), _SUBDIR)
+
+
+def _runtime_identity() -> str:
+    """Everything about the process that makes a serialized executable
+    loadable: a hit compiled under a different jax, backend, device set or
+    machine must miss (and would fail loudly at deserialize time anyway —
+    the key check just makes the common case cheap)."""
+    import jax
+
+    devs = jax.devices()
+    return ";".join(
+        [
+            f"schema{SCHEMA_VERSION}",
+            f"jax:{jax.__version__}",
+            f"backend:{jax.default_backend()}",
+            f"machine:{platform.machine()}",
+            f"devices:{len(devs)}",
+            "kinds:" + ",".join(sorted({getattr(d, "device_kind", "?") for d in devs})),
+        ]
+    )
+
+
+def cache_key(lowered: Any, devices: Any = None) -> Optional[str]:
+    """Content key for a ``jit(...).lower(...)`` result; None = uncacheable.
+
+    The HLO text pins the program (shapes, dtypes, shardings, donation all
+    lower into it); the runtime identity pins where it can load. ``devices``
+    (the concrete device block the program was lowered for) MUST be part of
+    the key whenever the caller compiles the same program for different
+    blocks: GSPMD sharding annotations use logical device indices, so the
+    physical assignment lives only in the executable — loading a twin
+    program pinned to a different block would silently run on the wrong
+    chips.
+    """
+    try:
+        text = lowered.as_text()
+    except Exception:
+        return None
+    h = hashlib.sha256()
+    h.update(_runtime_identity().encode())
+    h.update(b"\x00")
+    if devices is not None:
+        ids = ",".join(
+            str(getattr(d, "id", i)) for i, d in enumerate(devices)
+        )
+        h.update(f"block:{ids}".encode())
+        h.update(b"\x00")
+    h.update(text.encode())
+    return h.hexdigest()
+
+
+def _path(key: str) -> str:
+    return os.path.join(cache_dir(), f"{key}.jaxexec")
+
+
+def _load(key: str) -> Optional[Any]:
+    try:
+        with open(_path(key), "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+        from jax.experimental.serialize_executable import deserialize_and_load
+
+        return deserialize_and_load(payload, in_tree, out_tree)
+    except FileNotFoundError:
+        return None
+    except Exception as e:
+        # corrupt / stale / cross-context entry: a miss, never an error
+        _bump("errors")
+        log.info("aot cache entry %s unloadable (%r) — recompiling", key[:12], e)
+        try:
+            os.unlink(_path(key))
+        except OSError:
+            pass
+        return None
+
+
+def _store(key: str, compiled: Any) -> bool:
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        blob = pickle.dumps((payload, in_tree, out_tree))
+    except Exception as e:
+        _bump("errors")
+        log.info("aot executable not serializable (%r) — caching skipped", e)
+        return False
+    path = _path(key)
+    tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        os.makedirs(cache_dir(), exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    _bump("stores")
+    return True
+
+
+def load_or_compile(lowered: Any, devices: Any = None) -> Any:
+    """The compiled executable for ``lowered``, via the on-disk cache.
+
+    Cache hit: deserialize and skip XLA compilation entirely. Miss (or the
+    cache is disabled/unwritable/unloadable): ``lowered.compile()`` as
+    before, then serialize the result for the next process. The deserialized
+    executable runs the identical machine code a fresh compile would
+    produce, so results — including donation/aliasing behavior — are
+    unchanged. One caveat: ``memory_analysis()`` may be unavailable on a
+    deserialized executable; ``utils.timing.hbm_bytes_required`` already
+    degrades that to "feasible, with a warning".
+    """
+    if not enabled():
+        return lowered.compile()
+    key = cache_key(lowered, devices)
+    if key is None:
+        return lowered.compile()
+    hit = _load(key)
+    if hit is not None:
+        _bump("hits")
+        return hit
+    _bump("misses")
+    compiled = lowered.compile()
+    _store(key, compiled)
+    return compiled

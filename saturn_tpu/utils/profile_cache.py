@@ -240,9 +240,15 @@ class ProfileCache:
         per_batch_time: Optional[float] = None,
         source: str = "trial",
         memory_infeasible: bool = False,
+        host_fraction: float = 0.0,
     ) -> bool:
         """Atomically write one entry; False if the key or params aren't
-        cacheable (non-JSON params from a plugin technique)."""
+        cacheable (non-JSON params from a plugin technique).
+
+        ``host_fraction`` is the trial-measured staging-vs-compute split the
+        solver's co-location term consumes; pre-existing entries without the
+        field read back as 0.0 (never co-scheduled) via ``get``'s tolerance
+        for missing fields."""
         if not key:
             return False
         entry = {
@@ -255,6 +261,7 @@ class ProfileCache:
             "per_batch_time": per_batch_time,
             "source": source,
             "memory_infeasible": bool(memory_infeasible),
+            "host_fraction": float(host_fraction),
             "written": time.time(),
         }
         try:
@@ -293,6 +300,10 @@ class ProfileCache:
         prev = self.get(key)
         if prev is not None and prev.get("feasible") and params is None:
             params = prev.get("params")
+        # The realized interval measures wall time, not the staging split —
+        # carry the trial's host fraction forward so an upgraded entry stays
+        # co-schedulable.
+        hf = prev.get("host_fraction", 0.0) if prev is not None else 0.0
         return self.put(
             key,
             technique=technique,
@@ -301,6 +312,7 @@ class ProfileCache:
             params=params if isinstance(params, dict) else {},
             per_batch_time=float(per_batch_time),
             source="realized",
+            host_fraction=float(hf) if isinstance(hf, (int, float)) else 0.0,
         )
 
     def __len__(self) -> int:

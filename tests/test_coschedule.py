@@ -1,0 +1,807 @@
+"""Cross-job co-scheduling (round 11): the solver's host-fraction co-location
+term, the engine's interleave-aware group launcher, the condensed race guard,
+the AOT executable cache, and the host-fraction plumbing.
+
+The tentpole claim mirrors round 10's: interleaving two co-located jobs'
+windows on a shared launcher is a pure wall-clock packing change — each
+member's dispatch ORDER (and therefore its loss/checkpoint trajectory) is
+identical to a solo run. ``TestTrajectoryEquivalence`` asserts that
+bit-for-bit on real programs; everything else here is hardware-free fakes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from saturn_tpu.core.mesh import Block, SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.core.technique import BaseTechnique
+from saturn_tpu.executor import engine
+from saturn_tpu.solver.milp import (
+    Assignment,
+    Plan,
+    coschedule_candidates,
+    solve,
+)
+
+pytestmark = pytest.mark.coschedule
+
+
+class FakeDev:
+    platform = "cpu"
+    device_kind = "fake-cpu"
+    process_index = 0
+
+
+def topo(n=8):
+    return SliceTopology([FakeDev() for _ in range(n)])
+
+
+class FakeTask:
+    def __init__(self, name, total_batches, sizes, tech, pbt=0.001, hf=0.0):
+        self.name = name
+        self.total_batches = total_batches
+        self.current_batch = 0
+        self.epoch_length = 1000
+        self.strategies = {
+            g: Strategy(tech, g, {}, pbt * total_batches, pbt,
+                        host_fraction=hf)
+            for g in sizes
+        }
+        self.selected_strategy = None
+        self.realized = []  # per-batch feedback the launcher attributed
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+    def select_strategy(self, g):
+        self.selected_strategy = self.strategies[g]
+
+    def reconfigure(self, n):
+        self.current_batch = (self.current_batch + n) % self.epoch_length
+
+    def note_realized_per_batch(self, per_batch):
+        self.realized.append(per_batch)
+
+
+class RecordingTech(BaseTechnique):
+    """Plain execute-only technique (no generator support): in a co-schedule
+    group it must take the sequential-fallback path."""
+
+    name = "fake"
+
+    def __init__(self, per_batch=0.001):
+        self.per_batch = per_batch
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        time.sleep(self.per_batch * (override_batch_count or 1))
+        with self.lock:
+            self.calls.append(
+                (task.name, len(devices), override_batch_count,
+                 time.monotonic())
+            )
+
+    def search(self, task, devices, tid):
+        return {}, self.per_batch
+
+
+class GenTech(BaseTechnique):
+    """Generator-capable fake: each unit optionally 'stages' (yields
+    "waiting") before dispatching, mimicking a stage-bound job whose host
+    phases the group launcher fills with a neighbor's windows."""
+
+    name = "gen"
+    supports_coschedule = True
+
+    def __init__(self, log, stage_delay=0.0, fail_at=None):
+        self.log = log  # shared across instances: global dispatch order
+        self.lock = threading.Lock()
+        self.stage_delay = stage_delay
+        self.fail_at = fail_at
+        self.finalized = []
+
+    def interval_dispatches(self, task, devices, tid,
+                            override_batch_count=None, shared=False):
+        n = int(override_batch_count or 1)
+        for u in range(n):
+            if self.fail_at is not None and u == self.fail_at:
+                raise RuntimeError(f"injected dispatch failure at unit {u}")
+            if shared and self.stage_delay:
+                ready = time.monotonic() + self.stage_delay
+                while time.monotonic() < ready:
+                    yield ("waiting", u)
+            with self.lock:
+                self.log.append((task.name, u))
+            yield ("dispatched", u)
+        yield ("drain", n)
+        with self.lock:
+            self.finalized.append(task.name)
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        for _ in self.interval_dispatches(
+            task, devices, tid, override_batch_count=override_batch_count
+        ):
+            pass
+
+    def search(self, task, devices, tid):
+        return {}, 0.001
+
+
+def co_plan(names, block=None, co=None, deps=None, starts=None):
+    block = block if block is not None else Block(0, 4)
+    return Plan(
+        assignments={
+            n: Assignment(
+                block.size, block,
+                float(starts[n]) if starts else 0.0, 1.0,
+            )
+            for n in names
+        },
+        makespan=1.0,
+        dependencies=deps if deps is not None else {n: [] for n in names},
+        coschedule=co or [],
+    )
+
+
+# ----------------------------------------------------------------- solver
+class TestCoscheduleCandidates:
+    def _choices(self, rt1=10.0, rt2=8.0):
+        return {
+            "a": [(4, Block(0, 4), rt1)],
+            "b": [(4, Block(0, 4), rt2)],
+        }
+
+    def test_host_fraction_predicts_win(self):
+        tech = RecordingTech()
+        a = FakeTask("a", 10, [4], tech, hf=0.8)
+        b = FakeTask("b", 10, [4], tech, hf=0.0)
+        cands = coschedule_candidates([a, b], self._choices(), 1.15)
+        assert len(cands) == 1
+        n1, n2, common = cands[0]
+        assert {n1, n2} == {"a", "b"}
+        # comb = max(10, 8, 0.2*10 + 1.0*8) = 10 -> gain 18/10 = 1.8
+        assert common[0][2] == pytest.approx(10.0)
+
+    def test_zero_host_fraction_never_qualifies(self):
+        """Two compute-bound jobs: comb = rt1 + rt2, gain exactly 1.0x."""
+        tech = RecordingTech()
+        a = FakeTask("a", 10, [4], tech, hf=0.0)
+        b = FakeTask("b", 10, [4], tech, hf=0.0)
+        assert coschedule_candidates([a, b], self._choices(), 1.15) == []
+
+    def test_min_gain_threshold(self):
+        tech = RecordingTech()
+        a = FakeTask("a", 10, [4], tech, hf=0.8)
+        b = FakeTask("b", 10, [4], tech, hf=0.0)
+        assert coschedule_candidates([a, b], self._choices(), 2.0) == []
+
+    def test_disjoint_options_never_pair(self):
+        tech = RecordingTech()
+        a = FakeTask("a", 10, [4], tech, hf=0.9)
+        b = FakeTask("b", 10, [4], tech, hf=0.9)
+        choices = {
+            "a": [(4, Block(0, 4), 10.0)],
+            "b": [(4, Block(4, 4), 8.0)],  # different block: no common option
+        }
+        assert coschedule_candidates([a, b], choices, 1.15) == []
+
+
+class TestSolverCoLocation:
+    def test_contended_pair_coscheduled(self):
+        """Two whole-topology jobs, one stage-bound: the MILP co-locates them
+        and the makespan collapses to ~max(rt) instead of the serial sum."""
+        tech = RecordingTech()
+        hosty = FakeTask("hosty", 100, [4], tech, pbt=0.1, hf=0.8)
+        compy = FakeTask("compy", 100, [4], tech, pbt=0.08, hf=0.0)
+        plan = solve([hosty, compy], topo(4))
+        assert plan.coschedule and sorted(plan.coschedule[0]) == [
+            "compy", "hosty"
+        ]
+        a1, a2 = plan.assignments["hosty"], plan.assignments["compy"]
+        assert a1.block.overlaps(a2.block)
+        # interleaved occupancy ~ max(10, 8, 0.2*10 + 8) = 10, not 18
+        assert plan.makespan <= 10.0 + 1e-6
+        # groupmates carry no ordering edge between them
+        assert "compy" not in plan.dependencies.get("hosty", [])
+        assert "hosty" not in plan.dependencies.get("compy", [])
+
+    def test_roomy_topology_prefers_disjoint(self):
+        """With room to run side by side, co-location must not be chosen:
+        disjoint placement gives the same makespan without sharing chips."""
+        tech = RecordingTech()
+        hosty = FakeTask("hosty", 100, [4], tech, pbt=0.1, hf=0.8)
+        compy = FakeTask("compy", 100, [4], tech, pbt=0.08, hf=0.0)
+        plan = solve([hosty, compy], topo(8))
+        assert plan.coschedule == []
+        a1, a2 = plan.assignments["hosty"], plan.assignments["compy"]
+        assert not a1.block.overlaps(a2.block)
+
+    def test_unmeasured_host_fraction_stays_serial(self):
+        """hf defaults to 0.0 (pre-existing cache entries): the pair predicts
+        no win, so contention serializes exactly as before this round."""
+        tech = RecordingTech()
+        t1 = FakeTask("a", 100, [4], tech, pbt=0.1, hf=0.0)
+        t2 = FakeTask("b", 100, [4], tech, pbt=0.08, hf=0.0)
+        plan = solve([t1, t2], topo(4))
+        assert plan.coschedule == []
+        assert plan.makespan >= 18.0 - 1e-6  # serial sum, plus slack
+
+    def test_plan_json_roundtrip_keeps_groups(self):
+        plan = co_plan(["a", "b"], co=[["a", "b"]])
+        back = Plan.from_json(plan.to_json())
+        assert back.coschedule == [["a", "b"]]
+
+    def test_compute_dependencies_skips_groupmates(self):
+        plan = co_plan(["a", "b"], co=[["a", "b"]])
+        plan.compute_dependencies()
+        assert plan.dependencies["a"] == [] and plan.dependencies["b"] == []
+        # without the group, the same overlap produces an ordering edge
+        solo = co_plan(["a", "b"])
+        solo.compute_dependencies()
+        assert solo.dependencies["a"] or solo.dependencies["b"]
+
+
+# ------------------------------------------------------------- race guard
+class TestRaceGuardCondensation:
+    """engine._check_disjoint on the condensed (group-level) graph: the
+    co-schedule edge composes with transitive serialization."""
+
+    def test_copair_overlap_allowed(self):
+        tech = RecordingTech()
+        t1, t2 = FakeTask("a", 4, [4], tech), FakeTask("b", 4, [4], tech)
+        plan = co_plan(["a", "b"], co=[["a", "b"]])
+        engine.execute([t1, t2], {"a": 4, "b": 4}, 10.0, plan, topo(8))
+        assert len(tech.calls) == 2
+
+    def test_overlap_without_edge_still_races(self):
+        tech = RecordingTech()
+        t1, t2 = FakeTask("a", 4, [4], tech), FakeTask("b", 4, [4], tech)
+        # a coschedule group naming only non-running tasks must not license
+        # the overlap
+        plan = co_plan(["a", "b"], co=[["x", "y"]])
+        with pytest.raises(RuntimeError, match="races"):
+            engine.execute([t1, t2], {"a": 4, "b": 4}, 10.0, plan, topo(8))
+        assert not tech.calls
+
+    def test_copair_inside_chain_serializes_transitively(self):
+        """c depends on group member b and overlaps the group's block: the
+        condensed graph serializes (group, c) — no race, ordered launch."""
+        tech = RecordingTech(per_batch=0.005)
+        tasks = [FakeTask(n, 4, [4], tech) for n in ("a", "b", "c")]
+        plan = co_plan(
+            ["a", "b", "c"], co=[["a", "b"]],
+            deps={"a": [], "b": [], "c": ["b"]},
+            starts={"a": 0.0, "b": 0.0, "c": 1.0},
+        )
+        engine.execute(tasks, {n: 4 for n in "abc"}, 10.0, plan, topo(8))
+        assert len(tech.calls) == 3
+        order = [c[0] for c in sorted(tech.calls, key=lambda c: c[3])]
+        assert order.index("c") > max(order.index("a"), order.index("b"))
+
+    def test_cycle_through_group_refused(self):
+        """a,b are one condensed node; a->c and c->b is a group-level cycle
+        — refused loudly, nothing launches."""
+        tech = RecordingTech()
+        tasks = [FakeTask(n, 4, [4], tech) for n in ("a", "b", "c")]
+        plan = co_plan(
+            ["a", "b", "c"], co=[["a", "b"]],
+            deps={"a": ["c"], "b": [], "c": ["b"]},
+        )
+        with pytest.raises(RuntimeError, match="cycle"):
+            engine.execute(tasks, {n: 4 for n in "abc"}, 10.0, plan, topo(8))
+        assert not tech.calls
+
+    def test_intra_group_dependency_refused(self):
+        """A member waiting on its groupmate's completion event would
+        deadlock the shared launcher — refused before launch."""
+        tech = RecordingTech()
+        t1, t2 = FakeTask("a", 4, [4], tech), FakeTask("b", 4, [4], tech)
+        plan = co_plan(["a", "b"], co=[["a", "b"]],
+                       deps={"a": [], "b": ["a"]})
+        with pytest.raises(RuntimeError, match="groupmate"):
+            engine.execute([t1, t2], {"a": 4, "b": 4}, 10.0, plan, topo(8))
+        assert not tech.calls
+
+    def test_plain_chain_still_allowed(self):
+        """Pre-round-11 behavior intact: a->b->c serializes (a, c)."""
+        tech = RecordingTech()
+        tasks = [FakeTask(n, 4, [4], tech) for n in ("a", "b", "c")]
+        plan = co_plan(
+            ["a", "b", "c"],
+            deps={"a": [], "b": ["a"], "c": ["b"]},
+            starts={"a": 0.0, "b": 1.0, "c": 2.0},
+        )
+        engine.execute(tasks, {n: 4 for n in "abc"}, 10.0, plan, topo(8))
+        assert len(tech.calls) == 3
+
+
+# --------------------------------------------------------- group launcher
+class TestGroupLauncher:
+    def test_stage_bound_member_is_filled_by_neighbor(self):
+        """'hosty' stages (yields "waiting") before every dispatch; 'compy'
+        dispatches instantly. The launcher must run compy's units during
+        hosty's staging gaps instead of parking — compy finishes all its
+        dispatches before hosty does."""
+        log = []
+        hosty_tech = GenTech(log, stage_delay=0.01)
+        compy_tech = GenTech(log)
+        h = FakeTask("hosty", 4, [4], hosty_tech)
+        c = FakeTask("compy", 4, [4], compy_tech)
+        plan = co_plan(["hosty", "compy"], co=[["hosty", "compy"]])
+        done = []
+        engine.execute(
+            [h, c], {"hosty": 4, "compy": 4}, 10.0, plan, topo(8),
+            on_task_done=lambda name, n: done.append((name, n)),
+        )
+        assert len(log) == 8
+        h_positions = [i for i, (n, _) in enumerate(log) if n == "hosty"]
+        c_positions = [i for i, (n, _) in enumerate(log) if n == "compy"]
+        # compy's device work filled hosty's host phases: every compy unit
+        # dispatched before hosty's last unit
+        assert max(c_positions) < max(h_positions)
+        # per-member dispatch ORDER is the solo order regardless of packing
+        assert [u for n, u in log if n == "hosty"] == [0, 1, 2, 3]
+        assert [u for n, u in log if n == "compy"] == [0, 1, 2, 3]
+        # drains resumed: both members ran their blocking finalization
+        assert hosty_tech.finalized == ["hosty"]
+        assert compy_tech.finalized == ["compy"]
+        # bookkeeping fired per member: cursor advance, durability callback,
+        # attributed realized feedback
+        assert h.current_batch == 4 and c.current_batch == 4
+        assert sorted(done) == [("compy", 4), ("hosty", 4)]
+        assert len(h.realized) == 1 and len(c.realized) == 1
+        assert h.realized[0] > 0 and c.realized[0] > 0
+
+    def test_sequential_fallback_for_plain_technique(self):
+        """A group member whose technique lacks generator support still runs
+        (sequentially, after the interleaved members) — correctness never
+        depends on supports_coschedule."""
+        log = []
+        gen_tech = GenTech(log)
+        plain_tech = RecordingTech()
+        g = FakeTask("gen", 3, [4], gen_tech)
+        p = FakeTask("plain", 3, [4], plain_tech)
+        plan = co_plan(["gen", "plain"], co=[["gen", "plain"]])
+        engine.execute([g, p], {"gen": 3, "plain": 3}, 10.0, plan, topo(8))
+        assert [u for n, u in log if n == "gen"] == [0, 1, 2]
+        assert len(plain_tech.calls) == 1
+        assert g.current_batch == 3 and p.current_batch == 3
+
+    def test_member_failure_isolates(self):
+        """One member's dispatch failure surfaces in errors; the healthy
+        groupmate still completes its interval."""
+        log = []
+        bad_tech = GenTech(log, fail_at=1)
+        good_tech = GenTech(log)
+        bad = FakeTask("bad", 4, [4], bad_tech)
+        good = FakeTask("good", 4, [4], good_tech)
+        plan = co_plan(["bad", "good"], co=[["bad", "good"]])
+        errors = engine.execute(
+            [bad, good], {"bad": 4, "good": 4}, 10.0, plan, topo(8),
+            failure_policy="drop",
+        )
+        assert set(errors) == {"bad"}
+        assert good.current_batch == 4
+        assert good_tech.finalized == ["good"]
+        assert bad.current_batch == 0  # failed member advanced nothing
+
+    def test_dependent_waits_for_whole_group(self):
+        """A task depending on one group member must observe the WHOLE group
+        finished (members share the block until the last drains)."""
+        log = []
+        slow = GenTech(log, stage_delay=0.01)
+        fast = GenTech(log)
+        after = RecordingTech()
+        a = FakeTask("a", 3, [4], slow)
+        b = FakeTask("b", 3, [4], fast)
+        c = FakeTask("c", 3, [4], after)
+        plan = co_plan(
+            ["a", "b", "c"], co=[["a", "b"]],
+            deps={"a": [], "b": [], "c": ["b"]},
+            starts={"a": 0.0, "b": 0.0, "c": 1.0},
+        )
+        starts = []
+        engine.execute(
+            [a, b, c], {n: 3 for n in "abc"}, 10.0, plan, topo(8),
+            on_task_start=lambda name: starts.append(
+                (name, list(slow.finalized), list(fast.finalized))
+            ),
+        )
+        assert len(log) == 6
+        # when c launched, BOTH members had already drained and finalized:
+        # the group's completion events fire only at group end
+        c_entry = next(s for s in starts if s[0] == "c")
+        assert c_entry[1] == ["a"] and c_entry[2] == ["b"]
+
+
+# ---------------------------------------------------------- window policy
+class WindowedTech(BaseTechnique):
+    name = "windowed"
+    supports_windows = True
+
+    def __init__(self):
+        self.windows = []
+        self.lock = threading.Lock()
+
+    def execute(self, task, devices, tid, override_batch_count=None,
+                window_size=None):
+        with self.lock:
+            self.windows.append((task.name, window_size))
+
+    def search(self, task, devices, tid):
+        return {}, 0.001
+
+
+class TestWindowCapPerInterval:
+    def test_pick_window_honors_explicit_cap(self, monkeypatch):
+        monkeypatch.setenv("SATURN_TPU_MAX_WINDOW", "8")
+        assert engine.pick_window(100, cap=2) == 2
+        assert engine.pick_window(100) == 8  # None still reads the env
+
+    def test_cap_resolved_once_per_interval(self, monkeypatch):
+        calls = {"n": 0}
+        real = engine._window_cap
+
+        def counting():
+            calls["n"] += 1
+            return real()
+
+        monkeypatch.setattr(engine, "_window_cap", counting)
+        tech = WindowedTech()
+        t1 = FakeTask("a", 8, [4], tech)
+        t2 = FakeTask("b", 8, [4], tech)
+        plan = Plan(
+            assignments={
+                "a": Assignment(4, Block(0, 4), 0.0, 1.0),
+                "b": Assignment(4, Block(4, 4), 0.0, 1.0),
+            },
+            makespan=1.0,
+            dependencies={"a": [], "b": []},
+        )
+        engine.execute([t1, t2], {"a": 8, "b": 8}, 10.0, plan, topo(8))
+        assert calls["n"] == 1
+
+    def test_env_flip_mid_interval_cannot_split_policy(self, monkeypatch):
+        """The cap is frozen at interval start: a SATURN_TPU_MAX_WINDOW flip
+        while task 'a' runs must not change task 'b''s window."""
+        import os
+
+        monkeypatch.setenv("SATURN_TPU_MAX_WINDOW", "3")
+
+        class FlippingTech(WindowedTech):
+            def execute(self, task, devices, tid, override_batch_count=None,
+                        window_size=None):
+                super().execute(task, devices, tid,
+                                override_batch_count=override_batch_count,
+                                window_size=window_size)
+                os.environ["SATURN_TPU_MAX_WINDOW"] = "1"
+
+        tech = FlippingTech()
+        t1 = FakeTask("a", 8, [4], tech)
+        t2 = FakeTask("b", 8, [4], tech)
+        plan = co_plan(
+            ["a", "b"], deps={"a": [], "b": ["a"]},
+            starts={"a": 0.0, "b": 1.0},
+        )
+        engine.execute([t1, t2], {"a": 8, "b": 8}, 10.0, plan, topo(8))
+        assert dict(tech.windows) == {"a": 3, "b": 3}
+
+
+# ------------------------------------------------------------- prefetcher
+class TestTryNext:
+    def test_not_ready_then_value(self):
+        from saturn_tpu.data.prefetch import NOT_READY, DevicePrefetcher
+
+        gate = threading.Event()
+
+        def stage(i):
+            gate.wait(2.0)
+            return i * 10
+
+        pf = DevicePrefetcher(2, stage, depth=2)
+        try:
+            assert pf.try_next() is NOT_READY  # staging parked on the gate
+            gate.set()
+            deadline = time.monotonic() + 2.0
+            got = pf.try_next()
+            while got is NOT_READY and time.monotonic() < deadline:
+                time.sleep(0.001)
+                got = pf.try_next()
+            assert got == 0
+        finally:
+            pf.close()
+
+    def test_exhaustion_raises_stopiteration(self):
+        from saturn_tpu.data.prefetch import NOT_READY, DevicePrefetcher
+
+        pf = DevicePrefetcher(2, lambda i: i, depth=2)
+        try:
+            seen = []
+            while len(seen) < 2:
+                got = pf.try_next()
+                if got is not NOT_READY:
+                    seen.append(got)
+            assert seen == [0, 1]
+            with pytest.raises(StopIteration):
+                pf.try_next()
+        finally:
+            pf.close()
+
+    def test_stage_error_reraised(self):
+        from saturn_tpu.data.prefetch import NOT_READY, DevicePrefetcher
+
+        def stage(i):
+            raise ValueError("boom")
+
+        pf = DevicePrefetcher(3, stage, depth=2)
+        try:
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                try:
+                    got = pf.try_next()
+                except ValueError:
+                    break
+                assert got is NOT_READY
+                time.sleep(0.001)
+            else:
+                pytest.fail("staged error never surfaced")
+        finally:
+            pf.close()
+
+
+# -------------------------------------------------------------- AOT cache
+class TestAotCache:
+    @pytest.fixture(autouse=True)
+    def _isolated(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SATURN_TPU_AOT_CACHE", "1")
+        monkeypatch.setenv("SATURN_TPU_PROFILE_CACHE_DIR", str(tmp_path))
+        yield
+
+    def _lowered(self):
+        import jax
+
+        return jax.jit(lambda x: x * 2.0 + 1.0).lower(np.arange(8.0))
+
+    def test_miss_store_hit_roundtrip(self):
+        import os
+
+        from saturn_tpu.utils import aot_cache
+
+        x = np.arange(8.0)
+        s0 = aot_cache.stats()
+        c1 = self._lowered().compile()
+        got1 = aot_cache.load_or_compile(self._lowered())
+        s1 = aot_cache.stats()
+        assert s1["misses"] - s0["misses"] == 1
+        assert s1["stores"] - s0["stores"] == 1
+        assert os.listdir(aot_cache.cache_dir())
+        got2 = aot_cache.load_or_compile(self._lowered())
+        s2 = aot_cache.stats()
+        assert s2["hits"] - s1["hits"] == 1
+        np.testing.assert_array_equal(np.asarray(got2(x)), np.asarray(c1(x)))
+        np.testing.assert_array_equal(np.asarray(got1(x)), np.asarray(c1(x)))
+
+    def test_corrupt_entry_degrades_to_recompile(self):
+        import os
+
+        from saturn_tpu.utils import aot_cache
+
+        aot_cache.load_or_compile(self._lowered())
+        (entry,) = [
+            os.path.join(aot_cache.cache_dir(), f)
+            for f in os.listdir(aot_cache.cache_dir())
+        ]
+        with open(entry, "wb") as f:
+            f.write(b"not a pickle")
+        s0 = aot_cache.stats()
+        got = aot_cache.load_or_compile(self._lowered())
+        s1 = aot_cache.stats()
+        assert s1["errors"] - s0["errors"] == 1
+        assert s1["misses"] - s0["misses"] == 1  # corrupt entry = a miss
+        np.testing.assert_array_equal(
+            np.asarray(got(np.arange(8.0))), np.arange(8.0) * 2.0 + 1.0
+        )
+
+    def test_device_block_is_part_of_the_key(self):
+        """Twin programs pinned to different blocks must never collide: the
+        physical device assignment lives only in the executable."""
+        import jax
+
+        from saturn_tpu.utils import aot_cache
+
+        low = self._lowered()
+        devs = jax.devices()
+        k1 = aot_cache.cache_key(low, devs[:4])
+        k2 = aot_cache.cache_key(low, devs[4:])
+        assert k1 and k2 and k1 != k2
+        assert aot_cache.cache_key(low, devs[:4]) == k1  # stable
+
+    def test_cpu_default_off_without_optin(self, monkeypatch):
+        from saturn_tpu.utils import aot_cache
+
+        monkeypatch.delenv("SATURN_TPU_AOT_CACHE", raising=False)
+        # conftest pins JAX_PLATFORMS=cpu, so the unset default must be OFF
+        # (the poisoned-cache hazard documented in tests/conftest.py)
+        assert not aot_cache.enabled()
+        monkeypatch.setenv("SATURN_TPU_AOT_CACHE", "0")
+        assert not aot_cache.enabled()
+
+
+# ------------------------------------------------- host-fraction plumbing
+class HFTech(BaseTechnique):
+    """Feasible everywhere; reports a fixed measured host fraction."""
+
+    name = "hf"
+    calls: list = []
+
+    def search(self, task, devices, tid):
+        type(self).calls.append((task.name, len(devices)))
+        g = len(devices)
+        self._hf = getattr(self, "_hf", {})
+        self._hf[(task.name, g)] = 0.7
+        return {"knob": g}, 0.08 / g + 0.02
+
+    def host_fraction_report(self, task_name, size):
+        return getattr(self, "_hf", {}).pop((task_name, size), None)
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        pass
+
+
+class EvalTask:
+    """Evaluator-facing duck type (mirrors tests/test_profile_cache.py)."""
+
+    class _DS:
+        batch_size = 8
+
+        def __len__(self):
+            return 8
+
+        def example_batch(self):
+            return np.zeros((8, 64), dtype=np.int32)
+
+        def batch(self, i):
+            return self.example_batch()
+
+    class _HP:
+        optimizer = "adamw"
+        kwargs: dict = {}
+
+    def __init__(self, name):
+        self.name = name
+        self.chip_range = None
+        self.total_batches = 100
+        self.strategies = {}
+        self.hints = {}
+        self.hparams = self._HP()
+
+    def get_model(self, **kw):
+        return ("cfg-v1",)
+
+    def get_dataset(self):
+        return self._DS()
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+
+class TestHostFractionPlumbing:
+    @pytest.fixture(autouse=True)
+    def _registry(self):
+        from saturn_tpu import library
+
+        library.register("hf", HFTech)
+        HFTech.calls = []
+        yield
+        library.deregister("hf")
+
+    def test_sweep_installs_and_cache_preserves(self, tmp_path):
+        from saturn_tpu.trial_runner import evaluator
+
+        cache_dir = str(tmp_path / "cache")
+        t = EvalTask("hfjob")
+        evaluator.search([t], technique_names=["hf"], topology=topo(8),
+                         profile_cache=cache_dir, prune=False)
+        measured = {g: s for g, s in t.strategies.items() if s.feasible}
+        assert measured
+        assert all(s.host_fraction == pytest.approx(0.7)
+                   for s in measured.values())
+        # a second sweep over the same signature is trial-free AND keeps the
+        # measured host fraction through the persistent cache
+        HFTech.calls = []
+        t2 = EvalTask("hfjob")
+        evaluator.search([t2], technique_names=["hf"], topology=topo(8),
+                         profile_cache=cache_dir, prune=False)
+        assert HFTech.calls == []
+        m2 = {g: s for g, s in t2.strategies.items() if s.feasible}
+        assert m2
+        assert all(s.host_fraction == pytest.approx(0.7)
+                   for s in m2.values())
+
+
+# ------------------------------------------- real-program trajectory proof
+def _real_task(tmp_path, tag, name):
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    return Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256,
+            n_tokens=64 * 8 * 8,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=6),
+        chip_range=[4],
+        name=name,  # the init PRNG stream follows the name
+        save_dir=str(tmp_path / tag),
+    )
+
+
+def _with_strategy(task, tech, size=4):
+    task.strategies = {
+        size: Strategy(executor=tech, apportionment=size, params={},
+                       runtime=1.0, per_batch_time=0.1)
+    }
+    return task
+
+
+@pytest.mark.perf
+class TestTrajectoryEquivalence:
+    def test_interleaved_pair_matches_solo_bitwise(self, tmp_path, devices8):
+        """Acceptance: run job A solo, then a fresh job A interleaved with a
+        co-located neighbor B on the SAME block via the group launcher. A's
+        final checkpoint (params, optimizer state, step) must be
+        bit-identical — interleaving changes wall-clock packing only."""
+        from saturn_tpu.parallel.dp import DataParallel
+        from saturn_tpu.utils import checkpoint as ckpt
+
+        real_topo = SliceTopology(devices8)
+
+        solo = _with_strategy(
+            _real_task(tmp_path, "solo", "co-eq"), DataParallel()
+        )
+        plan_solo = Plan(
+            assignments={"co-eq": Assignment(4, Block(0, 4), 0.0, 1.0)},
+            makespan=1.0, dependencies={"co-eq": []},
+        )
+        engine.execute([solo], {"co-eq": 6}, 100.0, plan_solo, real_topo)
+        ckpt.flush()
+        ref = dict(np.load(solo.ckpt_path))
+
+        pair_a = _with_strategy(
+            _real_task(tmp_path, "pair-a", "co-eq"), DataParallel()
+        )
+        pair_b = _with_strategy(
+            _real_task(tmp_path, "pair-b", "co-mate"), DataParallel()
+        )
+        plan_co = Plan(
+            assignments={
+                "co-eq": Assignment(4, Block(0, 4), 0.0, 1.0),
+                "co-mate": Assignment(4, Block(0, 4), 0.0, 1.0),
+            },
+            makespan=1.0,
+            dependencies={"co-eq": [], "co-mate": []},
+            coschedule=[["co-eq", "co-mate"]],
+        )
+        errors = engine.execute(
+            [pair_a, pair_b], {"co-eq": 6, "co-mate": 6}, 100.0, plan_co,
+            real_topo,
+        )
+        assert not errors
+        ckpt.flush()
+        got = dict(np.load(pair_a.ckpt_path))
+
+        assert int(ref["step"]) == int(got["step"]) == 6
+        assert set(ref) == set(got)
+        for key in ref:
+            np.testing.assert_array_equal(ref[key], got[key], err_msg=key)
+        # the neighbor also completed its own 6 steps
+        mate = dict(np.load(pair_b.ckpt_path))
+        assert int(mate["step"]) == 6
