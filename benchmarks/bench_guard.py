@@ -68,6 +68,38 @@ def run_bench() -> dict:
     )
 
 
+def bench_plan_errors(new: dict) -> list:
+    """Static plan verification for the benchmark's workload (saturn-lint).
+
+    The headline bench is a single job on the measuring host's slice; its
+    plan form is one full-capacity assignment. Running it through the real
+    verifier end-to-end (Block/SliceTopology arithmetic, launch + capacity
+    + timeline checks) means an analyzer or topology regression refuses the
+    row loudly instead of silently blessing numbers from a state the
+    orchestrator would reject.  Returns error diagnostics (JSON form).
+    """
+    sys.path.insert(0, REPO)
+    from saturn_tpu.analysis import verify_plan
+    from saturn_tpu.core.mesh import Block, SliceTopology
+    from saturn_tpu.solver import milp
+
+    topo = SliceTopology(devices=[object()])
+    plan = milp.Plan(
+        assignments={
+            "bench_gpt2": milp.Assignment(
+                apportionment=topo.capacity,
+                block=Block(0, topo.capacity),
+                start=0.0,
+                runtime=1.0,
+            )
+        },
+        makespan=1.0,
+    )
+    plan.compute_dependencies()
+    report = verify_plan(plan, topology=topo, subject="bench_guard")
+    return [d.to_json() for d in report.errors]
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (
@@ -89,6 +121,20 @@ def main() -> int:
         return 0
     n, parsed_ref = ref
     new = run_bench()
+    try:
+        plan_errors = bench_plan_errors(new)
+    except Exception as e:
+        plan_errors = [{"code": "SAT-P000", "severity": "error",
+                        "message": f"verifier unavailable: "
+                                   f"{type(e).__name__}: {e}"}]
+    if plan_errors:
+        # Refuse to record: a row measured under a plan the static verifier
+        # rejects is not a baseline anyone should compare against.
+        print(json.dumps({
+            "metric": "bench_guard", "status": "plan_verification_failed",
+            "value": new.get("value"), "diagnostics": plan_errors,
+        }))
+        return 1
     out = {
         "metric": "bench_guard",
         "value": new.get("value"),

@@ -1,0 +1,66 @@
+"""saturn-lint: static plan verifier + JAX hot-path analyzer.
+
+Two passes, one gate:
+
+- :mod:`saturn_tpu.analysis.plan_verifier` — Pass 1: verify any
+  :class:`~saturn_tpu.solver.milp.Plan` (fresh solve, warm re-solve,
+  journal replay, migration) before it reaches chips.  The engine's
+  dynamic race guard delegates here; the orchestrator, service, and
+  durability recovery call :func:`verify_or_raise` /
+  :func:`audit_journal` as a mandatory adoption gate.
+- :mod:`saturn_tpu.analysis.jax_lint` — Pass 2: retrace-risk registry,
+  hot-loop host-sync lint, donation lint, and PartitionSpec/mesh
+  sharding lint with ``file:line`` diagnostics, all on CPU.
+
+``python -m saturn_tpu.analysis`` lints a plan JSON, audits a journal
+directory, or lints a registered technique (:mod:`.cli`).
+
+This package is deliberately import-light (stdlib + diagnostics only at
+import time) so every layer — including ``utils`` fingerprinting — can
+depend on it without cycles.
+"""
+
+from __future__ import annotations
+
+from saturn_tpu.analysis.diagnostics import (  # noqa: F401
+    SCHEMA_VERSION,
+    AnalysisReport,
+    Diagnostic,
+    PlanVerificationError,
+)
+
+
+def verify_plan(plan, topology=None, tasks=None, names=None,
+                subject="plan") -> AnalysisReport:
+    """See :func:`saturn_tpu.analysis.plan_verifier.verify_plan`."""
+    from saturn_tpu.analysis import plan_verifier
+
+    return plan_verifier.verify_plan(plan, topology=topology, tasks=tasks,
+                                     names=names, subject=subject)
+
+
+def verify_or_raise(plan, topology=None, tasks=None, names=None,
+                    source="plan") -> AnalysisReport:
+    """See :func:`saturn_tpu.analysis.plan_verifier.verify_or_raise`."""
+    from saturn_tpu.analysis import plan_verifier
+
+    return plan_verifier.verify_or_raise(plan, topology=topology, tasks=tasks,
+                                         names=names, source=source)
+
+
+def audit_journal(root, topology=None, tasks=None) -> AnalysisReport:
+    """See :func:`saturn_tpu.analysis.plan_verifier.audit_journal`."""
+    from saturn_tpu.analysis import plan_verifier
+
+    return plan_verifier.audit_journal(root, topology=topology, tasks=tasks)
+
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "AnalysisReport",
+    "Diagnostic",
+    "PlanVerificationError",
+    "audit_journal",
+    "verify_or_raise",
+    "verify_plan",
+]

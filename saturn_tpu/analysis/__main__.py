@@ -1,0 +1,7 @@
+"""Entry point: ``python -m saturn_tpu.analysis``."""
+
+import sys
+
+from saturn_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,116 @@
+"""``python -m saturn_tpu.analysis`` — lint before you burn chip time.
+
+Subcommands:
+
+- ``plan PLAN.json``: verify one plan (the ``to_json`` form committed to
+  journals / emitted by the solver).  ``--topology N`` adds the
+  capacity-feasibility checks for an N-device slice.
+- ``journal DIR``: audit every ``plan_commit`` record in a durability
+  journal — what recovery would replay after a crash.
+- ``technique NAME``: lint a registered technique's sharding rules and
+  hot-loop source (``--size`` sets the probe sub-mesh size).
+
+Exit code 0 = no error-severity diagnostics; 1 = at least one error;
+2 = usage/IO failure.  ``--json`` prints the machine-readable report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from saturn_tpu.analysis.diagnostics import AnalysisReport
+
+
+def _emit(report: AnalysisReport, as_json: bool) -> int:
+    if as_json:
+        print(json.dumps(report.to_json(), sort_keys=True, default=str))
+    else:
+        print(report.render())
+    return 0 if report.ok else 1
+
+
+def _cmd_plan(args: argparse.Namespace) -> int:
+    from saturn_tpu.analysis import plan_verifier
+    from saturn_tpu.solver import milp
+
+    try:
+        with open(args.path) as f:
+            payload = json.load(f)
+        plan = milp.Plan.from_json(payload)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"cannot load plan from {args.path!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    topology = None
+    if args.topology:
+        from saturn_tpu.core.mesh import SliceTopology
+
+        topology = SliceTopology(devices=list(range(args.topology)))
+    report = plan_verifier.verify_plan(plan, topology=topology,
+                                       subject=args.path)
+    return _emit(report, args.json)
+
+
+def _cmd_journal(args: argparse.Namespace) -> int:
+    from saturn_tpu.analysis import plan_verifier
+
+    report = plan_verifier.audit_journal(args.path)
+    return _emit(report, args.json)
+
+
+def _cmd_technique(args: argparse.Namespace) -> int:
+    from saturn_tpu.analysis import jax_lint
+
+    try:
+        from saturn_tpu import library
+
+        try:
+            tech = library.retrieve(args.name)
+        except KeyError:
+            library.register_default_library()
+            tech = library.retrieve(args.name)
+    except (KeyError, ImportError) as e:
+        print(f"cannot retrieve technique {args.name!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if isinstance(tech, type):
+        tech = tech()
+    report = jax_lint.lint_technique(tech, size=args.size)
+    return _emit(report, args.json)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m saturn_tpu.analysis",
+        description="saturn-lint: static plan verifier + JAX hot-path "
+                    "analyzer",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("plan", help="verify a plan JSON file")
+    p.add_argument("path")
+    p.add_argument("--topology", type=int, default=0, metavar="N",
+                   help="device count for capacity-feasibility checks")
+    p.set_defaults(fn=_cmd_plan)
+
+    j = sub.add_parser("journal", help="audit a durability journal dir")
+    j.add_argument("path")
+    j.set_defaults(fn=_cmd_journal)
+
+    t = sub.add_parser("technique", help="lint a registered technique")
+    t.add_argument("name")
+    t.add_argument("--size", type=int, default=8,
+                   help="probe sub-mesh size (default 8)")
+    t.set_defaults(fn=_cmd_technique)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

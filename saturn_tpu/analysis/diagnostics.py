@@ -1,0 +1,201 @@
+"""Structured diagnostics for the static-analysis subsystem.
+
+Every analyzer pass (plan verifier, JAX program lint, journal audit)
+emits :class:`Diagnostic` records collected into an
+:class:`AnalysisReport` — structured, machine-readable results with
+minimal counterexamples, instead of the bare ``RuntimeError`` the
+engine's dynamic guard historically raised.
+
+Diagnostic code catalog (the authoritative list; ``docs/analysis.md``
+mirrors it for humans):
+
+Plan verifier (``SAT-P*``) — ``plan_verifier.verify_plan``:
+
+========== ========= ===========================================================
+code       severity  meaning
+========== ========= ===========================================================
+SAT-P001   error     device race: blocks overlap with no ordering path or
+                     co-schedule edge between the two tasks
+SAT-P002   error     dependency cycle through a condensed co-schedule node —
+                     the gang launch would deadlock
+SAT-P003   error     co-scheduled task depends on its groupmate — an
+                     intra-group completion wait deadlocks the shared launcher
+SAT-P010   warning   dependency names a task with no assignment in the plan
+SAT-P011   warning   co-schedule group names a task with no assignment
+SAT-P012   warning   co-schedule group has fewer than two members
+SAT-P013   warning   task appears in multiple co-schedule groups (groups merge)
+SAT-P020   error     assignment block exceeds the topology's buddy capacity
+SAT-P021   error     assignment apportionment differs from its block size
+SAT-P022   error     task has no feasible strategy at the assigned apportionment
+SAT-P023   warning   co-schedule group members do not share one device block
+SAT-P024   warning   co-scheduled task has no measured host fraction (> 0)
+SAT-P030   error     negative start time or negative runtime
+SAT-P031   error     task starts before a task it depends on
+SAT-P032   warning   recorded makespan is below the last assignment's end time
+SAT-P033   warning   deadline arithmetic: start + runtime overruns the deadline
+========== ========= ===========================================================
+
+JAX program lint (``SAT-L*``) — ``jax_lint``:
+
+========== ========= ===========================================================
+SAT-L001   warning   retrace risk: novel abstract signature for an already
+                     compiled (bundle, K) dispatch key
+SAT-L002   error     implicit host sync inside the interval hot loop outside a
+                     ``lint: sanctioned-host-sync`` marker
+SAT-L003   error     donated window stack referenced after the donating dispatch
+SAT-L010   error     PartitionSpec references a mesh axis the mesh doesn't have
+SAT-L011   warning   sharded dimension not divisible by its mesh axes (error
+                     under ``strict``)
+SAT-L012   error     PartitionSpec rank exceeds the tensor rank
+========== ========= ===========================================================
+
+Journal audit (``SAT-J*``) — ``plan_verifier.audit_journal``:
+
+========== ========= ===========================================================
+SAT-J001   error     replayed plan_commit record fails static verification
+                     (quarantined, never adopted)
+SAT-J002   error     journal unreadable / plan_commit payload undecodable
+========== ========= ===========================================================
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Version of the analyzer's rule set + diagnostic schema. Bumped whenever a
+#: check is added/removed or a code changes meaning. Mixed into the profile
+#: and AOT cache fingerprints (``utils/profile_cache.py``,
+#: ``utils/aot_cache.py``) so a plan repaired under one rule set never reads
+#: back cache entries recorded under another.
+SCHEMA_VERSION = 1
+
+#: severity levels, weakest to strongest
+SEVERITIES = ("info", "warning", "error")
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One analyzer finding.
+
+    ``counterexample`` is the minimal witness — e.g. the two task names and
+    their half-open device ranges for a race, or the cycle's node list —
+    small JSON-serializable data, never whole plans.  ``location`` is a
+    ``file:line`` string for source-level lints (sharding rules, hot-loop
+    host syncs) and ``None`` for plan-level checks.
+    """
+
+    code: str
+    severity: str
+    message: str
+    counterexample: Optional[Dict[str, Any]] = None
+    location: Optional[str] = None
+    category: str = "plan"
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "category": self.category,
+        }
+        if self.counterexample is not None:
+            out["counterexample"] = self.counterexample
+        if self.location is not None:
+            out["location"] = self.location
+        return out
+
+
+@dataclass
+class AnalysisReport:
+    """All diagnostics from one analyzer run over one subject."""
+
+    subject: str = "plan"
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def add(self, diag: Diagnostic) -> None:
+        self.diagnostics.append(diag)
+
+    def extend(self, diags: List[Diagnostic]) -> None:
+        self.diagnostics.extend(diags)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        """True when no error-severity diagnostic was emitted."""
+        return not self.errors
+
+    def codes(self) -> List[str]:
+        return [d.code for d in self.diagnostics]
+
+    def filter(self, category: Optional[str] = None) -> List[Diagnostic]:
+        if category is None:
+            return list(self.diagnostics)
+        return [d for d in self.diagnostics if d.category == category]
+
+    def summary(self) -> str:
+        n_e, n_w = len(self.errors), len(self.warnings)
+        status = "FAIL" if n_e else "ok"
+        return (f"{self.subject}: {status} "
+                f"({n_e} error(s), {n_w} warning(s))")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "schema": SCHEMA_VERSION,
+            "subject": self.subject,
+            "ok": self.ok,
+            "diagnostics": [d.to_json() for d in self.diagnostics],
+        }
+
+    def render(self) -> str:
+        """Human-readable multi-line rendering (CLI output)."""
+        lines = [self.summary()]
+        for d in self.diagnostics:
+            loc = f" [{d.location}]" if d.location else ""
+            lines.append(f"  {d.code} {d.severity}{loc}: {d.message}")
+            if d.counterexample:
+                lines.append(
+                    "      counterexample: "
+                    + json.dumps(d.counterexample, sort_keys=True, default=str)
+                )
+        return "\n".join(lines)
+
+
+class PlanVerificationError(RuntimeError):
+    """A gated plan path received a plan the static verifier rejects.
+
+    Subclasses ``RuntimeError`` so every existing caller that handled the
+    engine's dynamic-guard raise keeps working unchanged; carries the full
+    report for callers that quarantine rather than crash.
+    """
+
+    def __init__(self, report: AnalysisReport, source: str = "plan") -> None:
+        self.report = report
+        self.source = source
+        first = report.errors[0] if report.errors else None
+        detail = first.message if first else "verification failed"
+        super().__init__(
+            f"static plan verification failed for {source}: {detail} "
+            f"({len(report.errors)} error(s); codes: "
+            f"{sorted({d.code for d in report.errors})})"
+        )
+
+
+def make(code: str, severity: str, message: str,
+         counterexample: Optional[Dict[str, Any]] = None,
+         location: Optional[str] = None,
+         category: str = "plan") -> Diagnostic:
+    """Tiny constructor shim keeping call sites one line."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r}")
+    return Diagnostic(code=code, severity=severity, message=message,
+                      counterexample=counterexample, location=location,
+                      category=category)

@@ -1,0 +1,604 @@
+"""Pass 2 — JAX program lint: hot-loop and sharding hazards, on CPU.
+
+Four analyzers over technique code and bundle metadata, none of which
+needs a chip:
+
+- **Retrace risk** (:class:`SignatureRegistry`): an abstract-signature
+  registry per ``(bundle, K)`` dispatch key.  A novel shape/dtype
+  signature for an already-seen key means the next dispatch recompiles
+  (AOT-cache miss) — flagged *before* the compile burns chip time.
+- **Host-sync lint** (:func:`lint_host_syncs`): AST scan for implicit
+  device→host readbacks (``block_until_ready``, ``float(...)``,
+  ``.item()``, ``np.asarray``, ``device_get``, ``host_array``) inside a
+  loop body.  The interval hot loop is allowed exactly the syncs marked
+  ``# lint: sanctioned-host-sync`` (the warmup fence); the one real
+  loss drain sits after the loop and is out of scope by construction.
+- **Donation lint** (:func:`lint_donation`): donated window stacks /
+  state referenced after the donating dispatch.  A statement that
+  rebinds the name is treated as a kill — the rebind-from-donor idiom
+  (``state, loss = fused_fn(state, window)``) dominates real code.
+- **Sharding lint** (:func:`check_pspec` / :func:`lint_rules`): every
+  ``PartitionSpec`` a rule function emits is validated against the mesh
+  axis names and dimension divisibility before anything is lowered, so
+  GSPMD errors surface as ``file:line`` diagnostics on CPU instead of
+  compile failures on a v5e.
+
+Only :func:`abstract_signature` touches JAX (lazily); everything else is
+pure ``ast``/``inspect`` so the linter itself can never trigger the
+hazards it hunts.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import threading
+from typing import (
+    Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple,
+)
+
+from saturn_tpu.analysis.diagnostics import AnalysisReport, Diagnostic, make
+
+SANCTION_MARKER = "lint: sanctioned-host-sync"
+
+#: attribute / function names whose call forces a device->host sync
+_SYNC_ATTRS = {"block_until_ready", "item", "device_get", "host_array",
+               "asarray"}
+_SYNC_NAMES = {"float"}
+
+
+class ShardingLintError(ValueError):
+    """A rule function emitted a PartitionSpec the mesh cannot satisfy.
+
+    Raised at bundle-build time (before lowering) with the rule source
+    location; ``ValueError`` so the trial runner's infeasibility handling
+    treats it like any other rejected configuration.
+    """
+
+    def __init__(self, diagnostics: List[Diagnostic]) -> None:
+        self.diagnostics = diagnostics
+        first = diagnostics[0]
+        loc = f" [{first.location}]" if first.location else ""
+        super().__init__(f"{first.code}{loc}: {first.message}")
+
+
+# ---------------------------------------------------------------------------
+# retrace risk
+# ---------------------------------------------------------------------------
+
+def abstract_signature(tree: Any) -> Tuple[Tuple[str, Tuple[int, ...], str], ...]:
+    """Canonical (path, shape, dtype) tuple for a pytree of arrays /
+    ShapeDtypeStructs — the identity JAX traces against."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for path, leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        out.append((jax.tree_util.keystr(path), shape, dtype))
+    return tuple(out)
+
+
+class SignatureRegistry:
+    """Abstract-signature registry per ``(bundle_key, K)`` dispatch key.
+
+    ``note`` returns a SAT-L001 diagnostic when an already-compiled key is
+    about to trace a NOVEL signature — the static predictor of an AOT-cache
+    miss.  Thread-safe (bundle builds run on trial threads); bounded so a
+    pathological sweep cannot grow it without limit.
+    """
+
+    def __init__(self, cap: int = 4096) -> None:
+        self._seen: Dict[Tuple[Any, Any], set] = {}
+        self._lock = threading.Lock()
+        self._cap = cap
+        self.flagged: List[Diagnostic] = []
+
+    def note(self, bundle_key: Any, k: Any,
+             signature: Tuple) -> Optional[Diagnostic]:
+        key = (bundle_key, k)
+        with self._lock:
+            sigs = self._seen.get(key)
+            if sigs is None:
+                if len(self._seen) >= self._cap:
+                    self._seen.clear()  # epoch reset beats unbounded growth
+                self._seen[key] = {signature}
+                return None
+            if signature in sigs:
+                return None
+            sigs.add(signature)
+            diag = make(
+                "SAT-L001", "warning",
+                f"retrace risk: dispatch key {bundle_key!r} (K={k!r}) has "
+                f"already compiled {len(sigs) - 1} signature(s) and is now "
+                "tracing a novel shape/dtype set — the AOT cache will miss "
+                "and the next dispatch recompiles",
+                counterexample={"k": k, "n_signatures": len(sigs)},
+                category="jax",
+            )
+            self.flagged.append(diag)
+            if len(self.flagged) > 256:
+                del self.flagged[:128]
+            return diag
+
+    def drain(self) -> List[Diagnostic]:
+        with self._lock:
+            out, self.flagged = self.flagged, []
+            return out
+
+
+#: process-wide registry the technique layer notes into
+retrace_registry = SignatureRegistry()
+
+
+# ---------------------------------------------------------------------------
+# source helpers
+# ---------------------------------------------------------------------------
+
+def _source_of(fn: Callable) -> Tuple[Optional[str], int, str]:
+    """(abs file or None, first line number, dedented source) of ``fn``."""
+    fn = inspect.unwrap(fn)
+    fn = getattr(fn, "__func__", fn)
+    try:
+        path = inspect.getsourcefile(fn)
+        lines, first = inspect.getsourcelines(fn)
+    except (OSError, TypeError):
+        return None, 1, ""
+    return path, first, textwrap.dedent("".join(lines))
+
+
+def source_location(fn: Callable) -> Optional[str]:
+    """``file:line`` of a callable, or None for builtins/C functions."""
+    path, first, src = _source_of(fn)
+    if path is None:
+        return None
+    return f"{path}:{first}"
+
+
+def _loc(path: Optional[str], first: int, node: ast.AST) -> Optional[str]:
+    if path is None:
+        return None
+    return f"{path}:{first + node.lineno - 1}"
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# host-sync lint
+# ---------------------------------------------------------------------------
+
+def lint_host_syncs(fn: Callable,
+                    marker: str = SANCTION_MARKER) -> List[Diagnostic]:
+    """Flag device->host syncs inside loop bodies of ``fn``.
+
+    A sync on a line carrying ``marker`` — or directly below a line that
+    carries it — is sanctioned.  Only ``for``/``while`` bodies count as the
+    hot loop: a single drain after the loop is the sanctioned pattern by
+    construction.
+    """
+    path, first, src = _source_of(fn)
+    if not src:
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    src_lines = src.splitlines()
+
+    def sanctioned(node: ast.AST) -> bool:
+        for ln in (node.lineno, node.lineno - 1):
+            if 1 <= ln <= len(src_lines) and marker in src_lines[ln - 1]:
+                return True
+        return False
+
+    out: List[Diagnostic] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.loop_depth = 0
+
+        def visit_For(self, node: ast.For) -> None:
+            self._loop(node)
+
+        def visit_While(self, node: ast.While) -> None:
+            self._loop(node)
+
+        def _loop(self, node: ast.AST) -> None:
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            # nested defs run whenever called, not per loop iteration here
+            saved, self.loop_depth = self.loop_depth, 0
+            self.generic_visit(node)
+            self.loop_depth = saved
+
+        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+        def visit_Call(self, node: ast.Call) -> None:
+            name = _call_name(node)
+            is_sync = (
+                (isinstance(node.func, ast.Attribute) and name in _SYNC_ATTRS)
+                or (isinstance(node.func, ast.Name) and name in _SYNC_NAMES)
+            )
+            if is_sync and self.loop_depth > 0 and not sanctioned(node):
+                out.append(make(
+                    "SAT-L002", "error",
+                    f"implicit host sync {name!r} inside the hot loop — a "
+                    "device->host readback per iteration serializes the "
+                    "dispatch pipeline; drain once after the loop or mark "
+                    f"the line '# {SANCTION_MARKER}'",
+                    counterexample={"call": name},
+                    location=_loc(path, first, node),
+                    category="jax",
+                ))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# donation lint
+# ---------------------------------------------------------------------------
+
+def _stmt_kills(stmt: ast.stmt, name: str) -> bool:
+    """True when the statement rebinds ``name`` (treated as a kill even if
+    its RHS reads the donated value: the rebind-from-donor idiom)."""
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+    return False
+
+
+def _expr_load(node: ast.AST, name: str) -> Optional[ast.Name]:
+    """First Load of ``name`` in an expression subtree."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id == name and isinstance(n.ctx, ast.Load):
+            return n
+    return None
+
+
+def _scan_stmt(stmt: ast.stmt, name: str) -> Tuple[str, Optional[ast.Name]]:
+    """('flag', load) | ('kill', None) | ('alive', None) for one statement,
+    respecting inner statement order — a branch that rebinds the name
+    before reading it kills the taint, it doesn't trip the lint."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return ("alive", None)  # separate scope; not executed here
+    if isinstance(stmt, ast.If):
+        load = _expr_load(stmt.test, name)
+        if load is not None:
+            return ("flag", load)
+        rb = _scan_stmts(stmt.body, name)
+        ro = _scan_stmts(stmt.orelse, name)
+        for r in (rb, ro):
+            if r[0] == "flag":
+                return r
+        if rb[0] == "kill" and ro[0] == "kill" and stmt.orelse:
+            return ("kill", None)
+        return ("alive", None)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        load = _expr_load(stmt.iter, name)
+        if load is not None:
+            return ("flag", load)
+        for part in (stmt.body, stmt.orelse):
+            r = _scan_stmts(part, name)
+            if r[0] == "flag":
+                return r
+        return ("alive", None)  # zero-iteration path keeps the taint alive
+    if isinstance(stmt, ast.While):
+        load = _expr_load(stmt.test, name)
+        if load is not None:
+            return ("flag", load)
+        for part in (stmt.body, stmt.orelse):
+            r = _scan_stmts(part, name)
+            if r[0] == "flag":
+                return r
+        return ("alive", None)
+    if isinstance(stmt, ast.Try):
+        rb = _scan_stmts(stmt.body, name)
+        if rb[0] == "flag":
+            return rb
+        for h in stmt.handlers:
+            r = _scan_stmts(h.body, name)
+            if r[0] == "flag":
+                return r
+        rf = _scan_stmts(stmt.finalbody, name)
+        if rf[0] == "flag":
+            return rf
+        if rb[0] == "kill" or rf[0] == "kill":
+            return ("kill", None)
+        return ("alive", None)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            load = _expr_load(item, name)
+            if load is not None:
+                return ("flag", load)
+        return _scan_stmts(stmt.body, name)
+    if _stmt_kills(stmt, name):
+        return ("kill", None)
+    load = _expr_load(stmt, name)
+    if load is not None:
+        return ("flag", load)
+    return ("alive", None)
+
+
+def _scan_stmts(stmts: Sequence[ast.stmt],
+                name: str) -> Tuple[str, Optional[ast.Name]]:
+    for s in stmts:
+        r = _scan_stmt(s, name)
+        if r[0] != "alive":
+            return r
+    return ("alive", None)
+
+
+def lint_donation(fn: Callable,
+                  donating: Mapping[str, Sequence[int]]) -> List[Diagnostic]:
+    """Flag reads of donated buffers after the donating dispatch.
+
+    ``donating`` maps callee names (``fused_fn`` / attribute name) to the
+    positional argument indices XLA donates.  The scan follows forward
+    control flow per statement list (if/else branches don't see each
+    other) plus the loop back edge; a statement that rebinds the donated
+    name kills the taint.
+    """
+    path, first, src = _source_of(fn)
+    if not src:
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    out: List[Diagnostic] = []
+
+    def scan_forward(name: str, stmts: Iterable[ast.stmt],
+                     call_node: ast.Call, callee: str) -> bool:
+        """Flag the first post-donation load of ``name``; True = resolved
+        (killed or flagged), False = taint survives this list."""
+        kind, load = _scan_stmts(list(stmts), name)
+        if kind == "flag" and load is not None:
+            out.append(make(
+                "SAT-L003", "error",
+                f"donated buffer {name!r} (argument of {callee!r}) is "
+                "read after dispatch — XLA has already reused its "
+                "memory; stage a fresh buffer instead",
+                counterexample={"name": name, "callee": callee,
+                                "donated_at": call_node.lineno + first - 1},
+                location=_loc(path, first, load),
+                category="jax",
+            ))
+            return True
+        return kind == "kill"
+
+    def own_nodes(stmt: ast.stmt) -> Iterable[ast.AST]:
+        """The statement's own expressions — headers only for compound
+        statements, whose bodies are handled at their own nesting level
+        (where the rebind-kill applies to the right statement list)."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            heads: List[ast.AST] = [stmt.test]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            heads = [stmt.target, stmt.iter]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            heads = list(stmt.items)
+        elif isinstance(stmt, (ast.Try, ast.FunctionDef,
+                               ast.AsyncFunctionDef, ast.ClassDef)):
+            heads = []
+        else:
+            heads = [stmt]
+        for h in heads:
+            yield from ast.walk(h)
+
+    def donations_in(stmt: ast.stmt) -> List[Tuple[ast.Call, str, List[str]]]:
+        found = []
+        for n in own_nodes(stmt):
+            if isinstance(n, ast.Call):
+                callee = _call_name(n)
+                if callee in donating:
+                    names = [a.id for i, a in enumerate(n.args)
+                             if i in tuple(donating[callee])
+                             and isinstance(a, ast.Name)]
+                    if names:
+                        found.append((n, callee, names))
+        return found
+
+    def handle(body: List[ast.stmt],
+               suffixes: List[List[ast.stmt]],
+               back_edge: Optional[List[ast.stmt]]) -> None:
+        for i, stmt in enumerate(body):
+            rest = body[i + 1:]
+            for call_node, callee, names in donations_in(stmt):
+                for name in names:
+                    if _stmt_kills(stmt, name):
+                        continue  # rebind-from-donor: taint dies at the call
+                    resolved = scan_forward(name, rest, call_node, callee)
+                    for suf in suffixes:
+                        if resolved:
+                            break
+                        resolved = scan_forward(name, suf, call_node, callee)
+                    if not resolved and back_edge is not None:
+                        scan_forward(name, back_edge, call_node, callee)
+            child_suffixes = [rest] + suffixes
+            if isinstance(stmt, ast.If):
+                handle(stmt.body, child_suffixes, back_edge)
+                handle(stmt.orelse, child_suffixes, back_edge)
+            elif isinstance(stmt, (ast.For, ast.While)):
+                handle(stmt.body, child_suffixes, stmt.body)
+                handle(stmt.orelse, child_suffixes, back_edge)
+            elif isinstance(stmt, ast.Try):
+                handle(stmt.body, [stmt.finalbody] + child_suffixes, back_edge)
+                for h in stmt.handlers:
+                    handle(h.body, [stmt.finalbody] + child_suffixes, back_edge)
+                handle(stmt.finalbody, child_suffixes, back_edge)
+            elif isinstance(stmt, ast.With):
+                handle(stmt.body, child_suffixes, back_edge)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            handle(node.body, [], None)
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sharding lint
+# ---------------------------------------------------------------------------
+
+def _spec_entries(spec: Any) -> List[Any]:
+    try:
+        return list(tuple(spec))
+    except TypeError:
+        return []
+
+
+def check_pspec(spec: Any, shape: Sequence[int], mesh_axes: Mapping[str, int],
+                *, path: str = "", strict: bool = False,
+                location: Optional[str] = None) -> List[Diagnostic]:
+    """Validate one PartitionSpec against mesh axis names + divisibility.
+
+    ``strict`` promotes divisibility findings to errors (GSPMD pads uneven
+    shards, which is at best silent waste and at worst an op that doesn't
+    support padding — strict mode refuses).
+    """
+    out: List[Diagnostic] = []
+    entries = _spec_entries(spec)
+    where = f" for {path!r}" if path else ""
+    if len(entries) > len(shape):
+        out.append(make(
+            "SAT-L012", "error",
+            f"PartitionSpec {tuple(entries)!r}{where} has rank "
+            f"{len(entries)} but the tensor has rank {len(shape)}",
+            counterexample={"path": path, "spec": [str(e) for e in entries],
+                            "shape": list(shape)},
+            location=location, category="sharding",
+        ))
+        return out
+    for dim, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        factor = 1
+        for axis in axes:
+            if axis not in mesh_axes:
+                out.append(make(
+                    "SAT-L010", "error",
+                    f"PartitionSpec{where} names mesh axis {axis!r} on dim "
+                    f"{dim} but the mesh only has axes "
+                    f"{sorted(mesh_axes)} — GSPMD would reject this at "
+                    "compile time",
+                    counterexample={"path": path, "dim": dim, "axis": axis,
+                                    "mesh_axes": dict(mesh_axes)},
+                    location=location, category="sharding",
+                ))
+                continue
+            factor *= int(mesh_axes[axis])
+        if factor > 1 and shape[dim] % factor != 0:
+            out.append(make(
+                "SAT-L011", "error" if strict else "warning",
+                f"dim {dim} of shape {tuple(shape)}{where} is sharded "
+                f"{factor}-way by {axes!r} but {shape[dim]} is not "
+                f"divisible by {factor} — GSPMD pads every shard",
+                counterexample={"path": path, "dim": dim,
+                                "size": shape[dim], "factor": factor},
+                location=location, category="sharding",
+            ))
+    return out
+
+
+def lint_rules(rules: Callable, params_shapes: Mapping[str, Sequence[int]],
+               mesh_axes: Mapping[str, int], *, strict: bool = False,
+               subject: str = "sharding-rules") -> AnalysisReport:
+    """Run a rule function over a {path: shape} map and validate every
+    emitted PartitionSpec.  Diagnostics carry the rule function's
+    ``file:line`` so a bad rule is a one-click fix."""
+    report = AnalysisReport(subject=subject)
+    location = source_location(rules)
+    for path, shape in params_shapes.items():
+        try:
+            spec = rules(path, tuple(shape), dict(mesh_axes))
+        except Exception as e:
+            report.add(make(
+                "SAT-L013", "error",
+                f"rule function raised for {path!r} {tuple(shape)!r}: "
+                f"{type(e).__name__}: {e}",
+                counterexample={"path": path, "shape": list(shape)},
+                location=location, category="sharding",
+            ))
+            continue
+        report.extend(check_pspec(spec, tuple(shape), mesh_axes, path=path,
+                                  strict=strict, location=location))
+    return report
+
+
+def enforce_pspec(spec: Any, shape: Sequence[int],
+                  mesh_axes: Mapping[str, int], *, path: str = "",
+                  rules: Optional[Callable] = None) -> None:
+    """Bundle-build gate: raise :class:`ShardingLintError` on any
+    error-severity sharding finding (unknown axis, rank overflow) for the
+    spec a rule just emitted.  Divisibility stays a warning here — the
+    in-tree rules guard it themselves and GSPMD tolerates padding."""
+    location = source_location(rules) if rules is not None else None
+    diags = check_pspec(spec, shape, mesh_axes, path=path, strict=False,
+                        location=location)
+    errors = [d for d in diags if d.severity == "error"]
+    if errors:
+        raise ShardingLintError(errors)
+
+
+def lint_technique(tech: Any, size: int = 8,
+                   params_shapes: Optional[Mapping[str, Sequence[int]]] = None,
+                   ) -> AnalysisReport:
+    """Best-effort static lint of a registered technique's sharding rules
+    plus its hot-loop source — the CLI's ``technique`` subcommand.
+
+    Uses the technique's own ``mesh_spec``/``param_rules`` hooks with an
+    empty config; techniques whose hooks require a real task degrade to an
+    informational diagnostic rather than failing the lint run.
+    """
+    name = getattr(tech, "name", type(tech).__name__)
+    report = AnalysisReport(subject=f"technique:{name}")
+    shapes = dict(params_shapes or {
+        # GPT-2-small-ish probe tree: embed, qkv, mlp, bias, vocab
+        "embed/kernel": (50257, 768),
+        "attn/qkv/kernel": (768, 2304),
+        "mlp/fc/kernel": (768, 3072),
+        "mlp/fc/bias": (3072,),
+        "ln/scale": (768,),
+    })
+    try:
+        axis_names, axis_sizes = tech.mesh_spec(size, None, {})
+        mesh_axes = dict(zip(axis_names, axis_sizes))
+        rules = tech.param_rules(None, {})
+    except Exception as e:
+        report.add(make(
+            "SAT-L020", "info",
+            f"technique {name!r} needs a concrete task to lint its rules "
+            f"({type(e).__name__}: {e}) — sharding lint skipped",
+            category="sharding",
+        ))
+    else:
+        report.extend(
+            lint_rules(rules, shapes, mesh_axes,
+                       subject=report.subject).diagnostics
+        )
+    hot = getattr(tech, "interval_dispatches", None)
+    if hot is not None:
+        report.extend(lint_host_syncs(hot))
+    return report

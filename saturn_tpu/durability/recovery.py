@@ -302,3 +302,19 @@ def build_restore_records(
         )
         out.append(rec)
     return out
+
+
+def audit_plan_commits(root: str, topology: Any = None,
+                       tasks: Optional[List] = None):
+    """Static-verification audit of every ``plan_commit`` in the journal.
+
+    Thin durability-side entry into the analyzer
+    (:func:`saturn_tpu.analysis.plan_verifier.audit_journal`): recovery
+    callers and the ``python -m saturn_tpu.analysis journal`` CLI share one
+    implementation. Returns the :class:`AnalysisReport`; adopting a replayed
+    plan that this audit rejects is the service-side quarantine bug this
+    hook exists to prevent (``SaturnService._recover_from``).
+    """
+    from saturn_tpu.analysis import plan_verifier
+
+    return plan_verifier.audit_journal(root, topology=topology, tasks=tasks)

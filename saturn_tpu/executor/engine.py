@@ -129,23 +129,14 @@ def _coschedule_find(run_tasks, plan):
     restricted to the launched tasks. Members of one group are one condensed
     node: they run interleaved on one shared launcher, so ordering and race
     properties are checked between groups, never inside one. Groups that
-    share a member merge (one launcher must own a task)."""
-    running = {t.name for t in run_tasks}
-    parent: Dict[str, str] = {n: n for n in running}
+    share a member merge (one launcher must own a task).
 
-    def find(n: str) -> str:
-        while parent[n] != n:
-            parent[n] = parent[parent[n]]  # path halving
-            n = parent[n]
-        return n
+    Thin delegate: the implementation lives in
+    ``analysis.plan_verifier.coschedule_find`` — one condensed-graph
+    construction shared by the dynamic guard and the static verifier."""
+    from saturn_tpu.analysis import plan_verifier
 
-    for grp in getattr(plan, "coschedule", None) or []:
-        members = [n for n in grp if n in running]
-        for a, b in zip(members, members[1:]):
-            ra, rb = find(a), find(b)
-            if ra != rb:
-                parent[ra] = rb
-    return find
+    return plan_verifier.coschedule_find((t.name for t in run_tasks), plan)
 
 
 def _check_disjoint(run_tasks, plan) -> None:
@@ -156,82 +147,17 @@ def _check_disjoint(run_tasks, plan) -> None:
     on events that never fire (silent hang) — the engine refuses loudly
     instead (SURVEY §5 concurrency-safety: detection, not just avoidance).
 
-    Checked on the CONDENSED graph whose nodes are co-schedule groups
-    (``plan.coschedule``) — a group's members intentionally share a block,
-    interleaved by one launcher, so the overlap rule applies between groups:
+    Thin delegate into the static analyzer
+    (``analysis.plan_verifier.check_launch_invariants``): the race / cycle /
+    intra-group-edge rules are ONE implementation with two call sites —
+    here, at the last line of defense before launch, and in the plan
+    verifier that gates every adoption path (solve, re-solve, journal
+    replay, migration). Raises ``RuntimeError`` with the historical
+    message on the first violation, in the historical check order
+    (groupmate edges, then cycles, then pairwise races)."""
+    from saturn_tpu.analysis import plan_verifier
 
-    - Two launched nodes may share devices only if the dependency graph
-      serializes them — TRANSITIVELY: the launcher's event-waits chain, so
-      a→b→c serializes (a, c) without a direct edge — or if they are the
-      same co-schedule group.
-    - The condensed dependency graph restricted to launched tasks must be
-      acyclic: the launcher only waits on running tasks, and a cycle parks
-      every thread in it forever.
-    - A dependency edge INSIDE a group is refused: group members launch
-      together, so a member waiting on its groupmate's completion event
-      would deadlock the shared launcher.
-    """
-    running = {t.name for t in run_tasks}
-    find = _coschedule_find(run_tasks, plan)
-
-    cdeps: Dict[str, set] = {find(n): set() for n in running}
-    for n in running:
-        rn = find(n)
-        for d in plan.dependencies.get(n, ()):
-            if d not in running:
-                continue
-            rd = find(d)
-            if rd == rn:
-                if d != n:
-                    raise RuntimeError(
-                        f"plan makes co-scheduled task {n!r} depend on its "
-                        f"groupmate {d!r}: group members run interleaved on "
-                        "one launcher, so an intra-group completion wait "
-                        "would deadlock the group"
-                    )
-                continue
-            cdeps[rn].add(rd)
-
-    # Reachability over the condensed dependency DAG; cycle check rides
-    # the same DFS (a node reaching itself).
-    reach: Dict[str, set] = {}
-
-    def reachable(r: str) -> set:
-        if r in reach:
-            return reach[r]
-        reach[r] = set()  # placeholder breaks self-recursion on cycles
-        out = set()
-        for d in cdeps[r]:
-            out.add(d)
-            out |= reachable(d)
-        reach[r] = out
-        return out
-
-    for r in cdeps:
-        if r in reachable(r):
-            raise RuntimeError(
-                f"plan dependency cycle through task {r!r}: the gang "
-                "launch would deadlock (every thread in the cycle waits "
-                "on another's completion event)"
-            )
-
-    items = [(t.name, plan.assignments.get(t.name)) for t in run_tasks]
-    for i, (n1, a1) in enumerate(items):
-        if a1 is None:
-            continue
-        for n2, a2 in items[i + 1:]:
-            if a2 is None or not a1.block.overlaps(a2.block):
-                continue
-            r1, r2 = find(n1), find(n2)
-            if r1 == r2:
-                continue  # co-scheduled: the shared block is the point
-            if r1 not in reachable(r2) and r2 not in reachable(r1):
-                raise RuntimeError(
-                    f"plan races tasks {n1!r} and {n2!r}: blocks "
-                    f"[{a1.block.offset}:{a1.block.end}] and "
-                    f"[{a2.block.offset}:{a2.block.end}] overlap with no "
-                    "ordering path or co-schedule edge between them"
-                )
+    plan_verifier.check_launch_invariants([t.name for t in run_tasks], plan)
 
 
 def _coschedule_groups(run_tasks, plan) -> List[List]:

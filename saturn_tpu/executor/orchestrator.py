@@ -19,12 +19,57 @@ import logging
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
+from saturn_tpu import analysis
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.executor import engine
 from saturn_tpu.solver import milp
 from saturn_tpu.utils import metrics, trace
 
 logger = logging.getLogger("saturn_tpu")
+
+
+def _gate_resolved_plan(candidate, previous, topo, tasks, interval,
+                        journal, interval_index):
+    """Static-verification gate on a re-solved plan (compare-and-swap side).
+
+    A candidate that fails :func:`saturn_tpu.analysis.verify_or_raise` is
+    QUARANTINED — never adopted — and the orchestrator falls back to the
+    previous interval's plan slid down by ``interval`` (exactly the keep
+    path of ``milp.resolve``), which passed the same gate last interval.
+    Only when no covering fallback exists (first plan, or new tasks the old
+    plan can't place) does the failure propagate.
+
+    Deterministic: multihost ranks gate the identical broadcast payload and
+    reach the identical adopt/quarantine decision.
+    """
+    try:
+        analysis.verify_or_raise(candidate, topology=topo, tasks=tasks,
+                                 source="re-solve")
+        return candidate
+    except analysis.PlanVerificationError as e:
+        codes = sorted({d.code for d in e.report.errors})
+        logger.error("re-solve plan quarantined (%s): %s", codes, e)
+        metrics.event("plan_quarantine", source="re-solve", codes=codes)
+        if journal is not None:
+            journal.append("plan_quarantine", interval=interval_index + 1,
+                           source="re-solve", codes=codes)
+        cur = {t.name for t in tasks}
+        if previous is None or (cur - set(previous.assignments)):
+            raise  # no covering fallback — refuse loudly, don't launch it
+        slid = milp.Plan(
+            assignments={
+                n: milp.Assignment(a.apportionment, a.block,
+                                   max(0.0, a.start - interval), a.runtime)
+                for n, a in previous.assignments.items() if n in cur
+            },
+            makespan=max(0.0, previous.makespan - interval),
+            coschedule=[
+                kept for grp in previous.coschedule
+                if len(kept := [n for n in grp if n in cur]) >= 2
+            ],
+        )
+        slid.compute_dependencies()
+        return slid
 
 
 def orchestrate(
@@ -153,6 +198,27 @@ def orchestrate(
 
         journal = jmod.Journal(resume_dir)  # recovers torn tails on open
         state = rmod.replay_batch_state(resume_dir)
+        if state.plan:
+            # Journal-replay audit: the orchestrator always re-solves on
+            # resume, but a committed plan the static verifier rejects
+            # means the pre-crash process launched (or was about to launch)
+            # a corrupt schedule — quarantine it on the record so the
+            # incident is durable and debuggable.
+            try:
+                replayed_report = analysis.verify_plan(
+                    milp.Plan.from_json(state.plan), subject="journal-replay"
+                )
+            except Exception as e:
+                replayed_report = None
+                logger.warning("replayed plan_commit undecodable: %s", e)
+            if replayed_report is not None and not replayed_report.ok:
+                codes = sorted({d.code for d in replayed_report.errors})
+                logger.warning(
+                    "journal's committed plan fails static verification "
+                    "(%s) — quarantined; resuming from a fresh solve", codes,
+                )
+                journal.log("plan_quarantine", source="journal-replay",
+                            codes=codes)
         if state.checkpoints:
             rmod.reconcile_checkpoints(state.checkpoints)
         task_list = _fold_batch_recovery(
@@ -332,6 +398,12 @@ def _handle_topology_change(
         replan_latency_s=_timeit.default_timer() - t_detect,
         capacity=result.topology.capacity, n_tasks=len(task_list),
     )
+    # Mandatory adoption gate (migration path): the replanner's plan targets
+    # a topology the running plan never saw — verify it against the NEW
+    # slice before any task is migrated onto it. There is no covering
+    # fallback plan on a changed topology, so a failure propagates.
+    analysis.verify_or_raise(result.plan, topology=result.topology,
+                             tasks=task_list, source="migration-replan")
     return task_list, result.topology, result.plan
 
 
@@ -376,6 +448,10 @@ def _orchestrate_loop(
             plan = milp.Plan.from_json(
                 distributed.broadcast_json(plan.to_json() if plan else None)
             )
+        # Mandatory adoption gate (fresh-solve path): a malformed initial
+        # plan fails HERE, with structured diagnostics, not at gang launch.
+        analysis.verify_or_raise(plan, topology=topo, tasks=task_list,
+                                 source="fresh-solve")
         logger.info("initial plan: makespan %.1fs, %d tasks", plan.makespan, len(task_list))
         metrics.event("solve", makespan_s=plan.makespan, n_tasks=len(task_list))
         if journal is not None:
@@ -471,7 +547,10 @@ def _orchestrate_loop(
                             "re-solve failed on coordinator: "
                             + payload["__solve_error__"]
                         )
-                    plan = milp.Plan.from_json(payload)
+                    plan = _gate_resolved_plan(
+                        milp.Plan.from_json(payload), plan, topo, remaining,
+                        interval, None, interval_index,
+                    )
                     logger.info("re-solve: makespan %.1fs", plan.makespan)
                     metrics.event("solve", makespan_s=plan.makespan,
                                   n_tasks=len(remaining))
@@ -479,7 +558,10 @@ def _orchestrate_loop(
                     # Join the overlapped solve BEFORE the failure handling
                     # below mutates Task/Strategy state the solver thread
                     # reads (retry rollback rewrites strategy runtimes).
-                    plan = future.result()
+                    plan = _gate_resolved_plan(
+                        future.result(), plan, topo, remaining, interval,
+                        journal, interval_index,
+                    )
                     future = None
                     # Evictions happen after the solve was submitted: the
                     # plan may still cover dropped tasks; their slots simply

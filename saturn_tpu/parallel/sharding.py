@@ -108,11 +108,22 @@ def compose_rules(*rule_fns):
 
 
 def pspec_tree(params_shapes: Any, rules: Callable, mesh) -> Any:
-    """Apply a rule function over an abstract params tree -> PartitionSpec tree."""
+    """Apply a rule function over an abstract params tree -> PartitionSpec tree.
+
+    Every emitted spec passes the static sharding lint
+    (``analysis.jax_lint.enforce_pspec``): an unknown mesh axis or a spec
+    longer than the tensor's rank raises ``ShardingLintError`` with the rule
+    function's ``file:line`` here, on CPU — not as a GSPMD compile failure
+    on the chips."""
+    from saturn_tpu.analysis import jax_lint as _jlint
+
     mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
 
     def one(path, leaf):
-        return rules(_path_str(path), tuple(leaf.shape), mesh_axes)
+        spec = rules(_path_str(path), tuple(leaf.shape), mesh_axes)
+        _jlint.enforce_pspec(spec, tuple(leaf.shape), mesh_axes,
+                             path=_path_str(path), rules=rules)
+        return spec
 
     return jax.tree_util.tree_map_with_path(one, params_shapes)
 

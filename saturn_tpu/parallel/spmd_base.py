@@ -128,6 +128,7 @@ class _Bundle:
     lowered: Any              # jit(...).lower(...) result, for memory analysis
     train_step: Any = None    # raw python step fn (fused scan re-traces it)
     batch_sds: Any = None     # ShapeDtypeStruct of one host batch
+    retrace_key: Any = None   # stable (task, config, block) dispatch identity
     _compiled: Any = None
     _fused: Dict[int, Any] = field(default_factory=dict)
     _fused_lock: Any = field(default_factory=threading.Lock)
@@ -198,6 +199,19 @@ class _Bundle:
         window_sds = jax.ShapeDtypeStruct(
             (k, *self.batch_sds.shape), self.batch_sds.dtype
         )
+        if self.retrace_key is not None:
+            # Static retrace-risk check (saturn-lint pass 2a): a novel
+            # abstract signature for an already-compiled (bundle, K) key
+            # means this compile is an AOT-cache miss the plan didn't
+            # budget for — flag it before it burns chip time.
+            from saturn_tpu.analysis import jax_lint as _jlint
+
+            diag = _jlint.retrace_registry.note(
+                self.retrace_key, k,
+                _jlint.abstract_signature((self.state_shapes, window_sds)),
+            )
+            if diag is not None:
+                log.warning("%s", diag.message)
         from saturn_tpu.utils import aot_cache
 
         compiled = aot_cache.load_or_compile(
@@ -581,6 +595,18 @@ class SPMDTechnique(BaseTechnique):
                     self._bundles.move_to_end(key)  # LRU touch
                     return hit
         bundle = self._build_uncached(task, devices, config)
+        bundle.retrace_key = key
+        # Seed the retrace-risk registry with the per-step signature so a
+        # later rebuild of the same dispatch key with novel shapes/dtypes
+        # (dataset drift, config mutation) is flagged before it recompiles.
+        from saturn_tpu.analysis import jax_lint as _jlint
+
+        diag = _jlint.retrace_registry.note(
+            key, "per-step",
+            _jlint.abstract_signature((bundle.state_shapes, bundle.batch_sds)),
+        )
+        if diag is not None:
+            log.warning("%s", diag.message)
         if use_cache:
             with self._bundles_lock:
                 self._bundles[key] = bundle
@@ -620,8 +646,17 @@ class SPMDTechnique(BaseTechnique):
         rules = self.param_rules(task, config)
         mem_kind = self.param_memory_kind(config)
 
+        from saturn_tpu.analysis import jax_lint as _jlint
+
         def shard_of(path, leaf):
             spec_ = rules(shr._path_str(path), tuple(leaf.shape), mesh_axes)
+            # Sharding lint (saturn-lint pass 2d): refuse a spec the mesh
+            # cannot satisfy (unknown axis, rank overflow) HERE, on CPU,
+            # with the rule's file:line — not as a GSPMD compile failure
+            # on the chips. Raises ShardingLintError (a ValueError, so the
+            # trial runner treats it like any infeasible configuration).
+            _jlint.enforce_pspec(spec_, tuple(leaf.shape), mesh_axes,
+                                 path=shr._path_str(path), rules=rules)
             if mem_kind is not None:
                 return NamedSharding(mesh, spec_, memory_kind=mem_kind)
             return NamedSharding(mesh, spec_)
@@ -971,7 +1006,7 @@ class SPMDTechnique(BaseTechnique):
                     # block on its result and restart the steady-state timer.
                     # (Shared mode skips the fence — blocking here would
                     # stall the group launcher; the group owns timing.)
-                    jax.block_until_ready(loss)
+                    jax.block_until_ready(loss)  # lint: sanctioned-host-sync
                     t_steady = _timeit.default_timer()
                 yield ("dispatched", u)
                 u += 1

@@ -178,6 +178,27 @@ class SaturnService:
                     "recovery: last committed plan unusable — first "
                     "re-solve starts cold"
                 )
+            else:
+                # Static-verification quarantine (never trust a replayed
+                # plan_commit blindly): a committed plan that fails the
+                # verifier — torn by a crash mid-repair, or written by a
+                # buggy older build — must not warm-start execution. Drop
+                # it on the record and fall back to a fresh solve.
+                from saturn_tpu import analysis
+
+                report = analysis.verify_plan(
+                    self._recovered_plan, subject="journal-replay"
+                )
+                if not report.ok:
+                    codes = sorted({d.code for d in report.errors})
+                    self._recovered_plan = None
+                    logger.warning(
+                        "recovery: replayed plan fails static verification "
+                        "(%s) — quarantined; first re-solve starts cold",
+                        codes,
+                    )
+                    self.journal.log("plan_quarantine",
+                                     source="journal-replay", codes=codes)
         self.journal.log(
             "recovery", incarnation=state.incarnations + 1,
             replayed_seq=state.last_seq, replayed_records=state.n_records,
@@ -408,10 +429,35 @@ class SaturnService:
                     r.name: self._weight(r) for r in jobs.values()
                 }
                 t_solve = timeit.default_timer()
-                plan = milp.resolve(
+                candidate = milp.resolve(
                     tasks, topo, plan, self.interval, self.threshold,
                     tlimit, weights=weights,
                 )
+                # Mandatory adoption gate (service re-solve path): a
+                # candidate the static verifier rejects is quarantined and
+                # the service keeps last cycle's verified plan — which also
+                # stays the journal's recovery warm start, because the
+                # quarantined plan is never committed.
+                from saturn_tpu import analysis
+
+                try:
+                    analysis.verify_or_raise(
+                        candidate, topology=topo, tasks=tasks,
+                        source="service-re-solve",
+                    )
+                except analysis.PlanVerificationError as e:
+                    codes = sorted({d.code for d in e.report.errors})
+                    logger.error("re-solve plan quarantined (%s): %s",
+                                 codes, e)
+                    metrics.event("plan_quarantine",
+                                  source="service-re-solve", codes=codes)
+                    if jnl is not None:
+                        jnl.log("plan_quarantine", interval=interval_index,
+                                source="service-re-solve", codes=codes)
+                    if plan is None:
+                        raise  # no verified fallback: surface the failure
+                else:
+                    plan = candidate
                 metrics.event(
                     "solve", makespan_s=plan.makespan, n_tasks=len(tasks),
                     solve_s=round(timeit.default_timer() - t_solve, 6),

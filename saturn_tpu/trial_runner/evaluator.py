@@ -363,6 +363,24 @@ def _search_inner(
                 params, per_batch_time = tech.search(task, devices, tid)
                 break
             except Exception as e:  # a broken trial must not kill the sweep (``:27-28``)
+                from saturn_tpu.analysis.jax_lint import ShardingLintError
+
+                if isinstance(e, ShardingLintError):
+                    # Static sharding-lint refusal is deterministic — the
+                    # rule emits the same illegal spec on every retry, so
+                    # burning the backoff budget buys nothing. Record the
+                    # file:line diagnostics and mark the size infeasible.
+                    logger.info(
+                        "trial (%s, g=%d, %s): sharding lint refused: %s",
+                        task.name, g, name, e,
+                    )
+                    metrics.event(
+                        "sharding_lint", task=task.name, size=g,
+                        technique=name,
+                        codes=[d.code for d in e.diagnostics],
+                    )
+                    params, per_batch_time = None, None
+                    break
                 if attempt >= max(0, trial_retries):
                     logger.info(
                         "trial (%s, g=%d, %s) raised on attempt %d "

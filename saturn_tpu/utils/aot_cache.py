@@ -100,10 +100,15 @@ def _runtime_identity() -> str:
     the key check just makes the common case cheap)."""
     import jax
 
+    from saturn_tpu.analysis import SCHEMA_VERSION as _ANALYSIS_SCHEMA
+
     devs = jax.devices()
     return ";".join(
         [
             f"schema{SCHEMA_VERSION}",
+            # analyzer rule-set version: diagnostics-driven plan repairs
+            # must never deserialize executables cached under older rules
+            f"lint{_ANALYSIS_SCHEMA}",
             f"jax:{jax.__version__}",
             f"backend:{jax.default_backend()}",
             f"machine:{platform.machine()}",

@@ -182,9 +182,15 @@ def fingerprint(
         jax_version = jax.__version__
     except Exception:
         jax_version = "none"
+    from saturn_tpu.analysis import SCHEMA_VERSION as _ANALYSIS_SCHEMA
+
     payload = json.dumps(
         {
             "schema": SCHEMA_VERSION,
+            # Analyzer rule-set version: a plan repaired under one
+            # diagnostic schema must never warm-start from profiles
+            # recorded under another (saturn-lint round 12).
+            "analysis": _ANALYSIS_SCHEMA,
             "task": task_sig,
             "technique": technique,
             "size": int(size),
