@@ -100,6 +100,58 @@ def bench_plan_errors(new: dict) -> list:
     return [d.to_json() for d in report.errors]
 
 
+#: Required key -> type for one ``benchmarks/chaos_campaign.py`` output row.
+#: The campaign bench self-validates against this before printing, and CI
+#: can re-check recorded rows — a schema drift (renamed key, stringified
+#: count) breaks the comparison silently otherwise.
+CHAOS_ROW_REQUIRED = {
+    "metric": str,
+    "seeds": list,
+    "fault_classes": list,
+    "jobs": int,
+    "jobs_lost": int,
+    "restarts": int,
+    "quarantined_batches": int,
+    "makespan_inflation": float,
+    "trajectory_bit_identical": bool,
+    "sentinel_overhead_pct": float,
+    "platform": str,
+    "status": str,
+}
+
+
+def validate_chaos_row(row) -> list:
+    """Schema-check one chaos-campaign row; returns human-readable problems
+    (empty list = valid)."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in CHAOS_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            # bool is an int subclass; a True in a count field is a bug
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # a whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "chaos_campaign":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'chaos_campaign'"
+        )
+    if isinstance(row.get("seeds"), list) and len(row["seeds"]) < 3:
+        problems.append("fewer than 3 seeds")
+    if (isinstance(row.get("fault_classes"), list)
+            and len(row["fault_classes"]) < 4):
+        problems.append("fewer than 4 fault classes")
+    return problems
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (

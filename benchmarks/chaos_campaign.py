@@ -1,0 +1,254 @@
+"""Chaos campaign benchmark: seeded fault sweeps + sentinel overhead.
+
+Runs the PR-8 acceptance campaign end to end on the CPU mesh:
+
+- three seeded campaigns (``resilience.chaos.run_campaign``), each drawing
+  one fault per health class — NaN loss, loss spike, persistent batch
+  poisoning, dispatch stall — against two tiny GPT-2 jobs; the first seed
+  additionally arms a simulated SIGKILL at the ``post-rollback`` journal
+  barrier and restarts through it;
+- a fault-free baseline of the same jobs for the makespan-inflation ratio;
+- per campaign, a fault-free REFERENCE run with the campaign's final
+  quarantine pre-applied: ``compare_checkpoints`` then proves every job's
+  published checkpoint is byte-identical to training the same surviving
+  batch sequence without any faults (faults land in interval 0, so the
+  rollback target is the initial state and the comparison is exact);
+- the sentinel's hot-path cost: the fused dispatch loop from
+  ``benchmarks/step_pipeline.py`` timed with the end-of-interval loss fold
+  + report readback versus the bare last-loss readback it replaced.
+
+Prints ONE JSON line (schema: ``bench_guard.CHAOS_ROW_REQUIRED``, and this
+script refuses to print a row that fails ``bench_guard.validate_chaos_row``):
+
+    {"metric": "chaos_campaign", "seeds": [...], "fault_classes": [...],
+     "jobs": 6, "jobs_lost": 0, "restarts": 1, "quarantined_batches": 3,
+     "makespan_inflation": 2.4, "trajectory_bit_identical": true,
+     "sentinel_overhead_pct": 0.3, "platform": "cpu", "status": "ok"}
+
+``status`` is "ok" only when zero jobs were lost, every checkpoint matched
+its reference byte-for-byte, and the sentinel overhead stayed <= 2%.
+Run: ``python benchmarks/chaos_campaign.py`` (not part of tier-1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import timeit
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import saturn_tpu
+from saturn_tpu import HParams, Task, library
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.data.lm_dataset import make_lm_dataset
+from saturn_tpu.health import SentinelConfig, sentinel
+from saturn_tpu.models.gpt2 import build_gpt2
+from saturn_tpu.models.loss import pretraining_loss
+from saturn_tpu.parallel.dp import DataParallel
+from saturn_tpu.resilience.chaos import (
+    CampaignSpec,
+    HEALTH_FAULT_CLASSES,
+    compare_checkpoints,
+    run_campaign,
+)
+
+import bench_guard
+
+SEEDS = (11, 23, 47)
+SEQ_LEN = 16
+BATCH_SIZE = 2
+N_BATCHES = 8          # == epoch length: quarantine comparison stays exact
+TASK_NAMES = ("chaos-a", "chaos-b")
+
+
+def make_template(save_dir: str, name: str) -> Task:
+    return Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", seq_len=SEQ_LEN, **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=SEQ_LEN, batch_size=BATCH_SIZE, vocab_size=256,
+            n_tokens=SEQ_LEN * BATCH_SIZE * N_BATCHES,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=N_BATCHES),
+        chip_range=[2],
+        name=name,
+        save_dir=save_dir,
+    )
+
+
+def clone_tasks(templates, save_dir: str):
+    """Fresh per-run task list sharing the templates' profiled strategies.
+    Keeps the journal-stable names; only the checkpoint directory moves."""
+    os.makedirs(save_dir, exist_ok=True)
+    out = []
+    for t in templates:
+        c = t.clone(name=t.name)
+        c.save_dir = save_dir
+        out.append(c)
+    return out
+
+
+def run_plain(templates, save_dir: str, topo) -> float:
+    """One fault-free orchestration of the job set; returns wall seconds."""
+    tasks = clone_tasks(templates, save_dir)
+    t0 = timeit.default_timer()
+    saturn_tpu.orchestrate(
+        tasks, interval=30.0, topology=topo, solver_time_limit=2.0
+    )
+    return timeit.default_timer() - t0
+
+
+def sentinel_overhead_pct(tmp: str) -> float:
+    """Fused-dispatch loop (the per-step benchmark path) with the sentinel's
+    end-of-interval fold + report readback vs the bare last-loss readback.
+    The fold is ONE jitted scan over the interval's loss vector — the single
+    host transfer the interval already paid now moves 6 floats instead of 1."""
+    n, k = 256, 16
+    task = make_template(os.path.join(tmp, "overhead"), "overhead-probe")
+    tech = DataParallel()
+    bundle = tech.build(task, jax.devices()[:1], {})
+    ds = task.get_dataset()
+    fused = bundle.fused_compiled(k)
+    sharding = bundle.stacked_sharding()
+    cfg = SentinelConfig(enabled=True)
+
+    def stage(w: int):
+        host = np.stack(
+            [np.asarray(ds.batch((w * k + j) % N_BATCHES)) for j in range(k)]
+        )
+        return jax.device_put(host, sharding)
+
+    windows = [stage(w) for w in range(n // k)]
+
+    def run(with_sentinel: bool) -> float:
+        import jax.numpy as jnp
+
+        state = bundle.init()
+        losses = []
+        t0 = timeit.default_timer()
+        for w in windows:
+            state, loss = fused(state, w)
+            if with_sentinel:
+                losses.append(loss.reshape(-1))
+        if with_sentinel:
+            rep = sentinel.fold(
+                jnp.asarray(sentinel.carry_init()), jnp.concatenate(losses), cfg
+            )
+            float(np.asarray(jax.device_get(rep))[sentinel.REP_LAST_LOSS])
+        else:
+            float(np.asarray(jax.device_get(loss)).reshape(-1)[-1])
+        return timeit.default_timer() - t0
+
+    run(False)  # compile + warm both programs outside the timed passes
+    run(True)
+    t_off = min(run(False) for _ in range(3))
+    t_on = min(run(True) for _ in range(3))
+    return (t_on - t_off) / t_off * 100.0
+
+
+def main() -> None:
+    topo = SliceTopology(jax.devices())
+    library.register_default_library()
+    # Spike detection is workload policy (off by default); the campaign
+    # injects 1e9 spikes, so turn the EWMA screen on for every run here.
+    sentinel.set_config(SentinelConfig(enabled=True, spike_factor=8.0,
+                                       warmup_steps=2))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        templates = [
+            make_template(os.path.join(tmp, "templates"), n)
+            for n in TASK_NAMES
+        ]
+        saturn_tpu.search(templates, technique_names=["dp"], topology=topo)
+
+        run_plain(templates, os.path.join(tmp, "warmup"), topo)  # compile
+        baseline_s = run_plain(templates, os.path.join(tmp, "baseline"), topo)
+
+        restarts = jobs_lost = quarantined_total = 0
+        mismatches = []
+        campaign_times = []
+        for i, seed in enumerate(SEEDS):
+            spec = CampaignSpec(seed=seed, kill_during_rollback=(i == 0),
+                                poison_range=N_BATCHES, stall_s=0.25)
+            save = os.path.join(tmp, f"camp{seed}", "ckpts")
+            t0 = timeit.default_timer()
+            result = run_campaign(
+                lambda: clone_tasks(templates, save),
+                spec,
+                os.path.join(tmp, f"camp{seed}", "wal"),
+                interval=30.0, topology=topo, solver_time_limit=2.0,
+            )
+            campaign_times.append(timeit.default_timer() - t0)
+            restarts += result.restarts
+            jobs_lost += len(result.failed)
+            jobs_lost += sum(
+                1 for n in TASK_NAMES
+                if n not in result.completed and n not in result.failed
+            )
+            quarantined_total += sum(
+                len(v) for v in result.quarantined.values()
+            )
+
+            # Reference: same jobs, no faults, the campaign's final
+            # quarantine pre-applied — the surviving-batch trajectory the
+            # faulted run must have reproduced bit-for-bit.
+            ref_save = os.path.join(tmp, f"camp{seed}", "ref")
+            ref_tasks = clone_tasks(templates, ref_save)
+            for t in ref_tasks:
+                t.quarantine_batches(result.quarantined.get(t.name, []))
+            saturn_tpu.orchestrate(
+                ref_tasks, interval=30.0, topology=topo, solver_time_limit=2.0
+            )
+            mismatches.extend(
+                f"seed {seed}: {m}"
+                for m in compare_checkpoints(save, ref_save,
+                                             names=list(TASK_NAMES))
+            )
+
+        overhead = sentinel_overhead_pct(tmp)
+
+    bit_identical = not mismatches
+    row = {
+        "metric": "chaos_campaign",
+        "seeds": list(SEEDS),
+        "fault_classes": [str(c) for c in HEALTH_FAULT_CLASSES],
+        "jobs": len(SEEDS) * len(TASK_NAMES),
+        "jobs_lost": jobs_lost,
+        "restarts": restarts,
+        "quarantined_batches": quarantined_total,
+        "makespan_inflation": round(
+            (sum(campaign_times) / len(campaign_times)) / baseline_s, 3
+        ),
+        "trajectory_bit_identical": bit_identical,
+        "sentinel_overhead_pct": round(overhead, 3),
+        "platform": jax.devices()[0].platform,
+        "status": (
+            "ok"
+            if jobs_lost == 0 and bit_identical and overhead <= 2.0
+            else "degraded"
+        ),
+    }
+    if mismatches:
+        row["mismatches"] = mismatches[:8]
+    problems = bench_guard.validate_chaos_row(row)
+    if problems:
+        raise SystemExit(f"chaos row failed its own schema: {problems}")
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,8 @@ def orchestrate(
     recovery_policy="pause-resolve-resume",
     replan_degrade_factor=2.0,
     resume_dir=None,
+    health_guardian=None,
+    crash_barrier=None,
 ):
     """Solve the SPASE problem and run the batch to completion.
 
@@ -86,6 +88,8 @@ def orchestrate(
         recovery_policy=recovery_policy,
         replan_degrade_factor=replan_degrade_factor,
         resume_dir=resume_dir,
+        health_guardian=health_guardian,
+        crash_barrier=crash_barrier,
     )
 
 

@@ -9,6 +9,11 @@ Subcommands:
   journal — what recovery would replay after a crash.
 - ``technique NAME``: lint a registered technique's sharding rules and
   hot-loop source (``--size`` sets the probe sub-mesh size).
+- ``health DIR``: replay a durability journal's ``health_*`` records into
+  the per-task quarantine / detach / fault ledger the next incarnation
+  would restore.  ``--unquarantine TASK[:i,j,k]`` appends a durable
+  ``health_unquarantine`` record (all indices when no list is given) —
+  the operator-facing undo for a batch range the guardian skip-listed.
 
 Exit code 0 = no error-severity diagnostics; 1 = at least one error;
 2 = usage/IO failure.  ``--json`` prints the machine-readable report.
@@ -82,6 +87,75 @@ def _cmd_technique(args: argparse.Namespace) -> int:
     return _emit(report, args.json)
 
 
+def _cmd_health(args: argparse.Namespace) -> int:
+    from saturn_tpu.durability import journal as jmod
+    from saturn_tpu.durability import recovery as rmod
+    from saturn_tpu.health.guardian import HEALTH_EVENT_CODES
+
+    if args.unquarantine:
+        task, _, idx_s = args.unquarantine.partition(":")
+        indices = None
+        if idx_s:
+            try:
+                indices = [int(x) for x in idx_s.split(",") if x]
+            except ValueError:
+                print(f"bad index list in {args.unquarantine!r} "
+                      "(want TASK or TASK:i,j,k)", file=sys.stderr)
+                return 2
+        try:
+            jnl = jmod.Journal(args.path)
+        except OSError as e:
+            print(f"cannot open journal at {args.path!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        try:
+            jnl.log("health_unquarantine", task=task, indices=indices,
+                    operator=True)
+        finally:
+            jnl.close()
+
+    quarantined: dict = {}
+    detached: list = []
+    faults: dict = {}
+    try:
+        records = list(jmod.replay(args.path))
+    except OSError as e:
+        print(f"cannot replay journal at {args.path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    for rec in records:
+        kind, d = rec["kind"], rec.get("data", {})
+        if kind == "health_fault":
+            per = faults.setdefault(d.get("task", ""), {})
+            cause = d.get("cause", "unknown")
+            per[cause] = per.get(cause, 0) + 1
+        else:
+            rmod.fold_health_record(kind, d, quarantined, detached)
+    payload = {
+        "quarantined": quarantined,
+        "detached": sorted(detached),
+        "faults": faults,
+        "event_codes": HEALTH_EVENT_CODES,
+    }
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+        return 0
+    if not (quarantined or detached or faults):
+        print(f"{args.path}: no health records in the durable journal")
+        return 0
+    for task in sorted(set(quarantined) | set(detached) | set(faults)):
+        bits = []
+        if task in faults:
+            bits.append("faults " + ", ".join(
+                f"{c}x{n}" for c, n in sorted(faults[task].items())))
+        if quarantined.get(task):
+            bits.append(f"quarantined batches {quarantined[task]}")
+        if task in detached:
+            bits.append("detached from co-schedule groups")
+        print(f"{task}: " + "; ".join(bits))
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m saturn_tpu.analysis",
@@ -107,6 +181,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     t.add_argument("--size", type=int, default=8,
                    help="probe sub-mesh size (default 8)")
     t.set_defaults(fn=_cmd_technique)
+
+    h = sub.add_parser(
+        "health", help="inspect (or undo) journaled training-health state"
+    )
+    h.add_argument("path")
+    h.add_argument("--unquarantine", metavar="TASK[:i,j,k]", default=None,
+                   help="append a durable un-quarantine record for TASK "
+                        "(all its indices, or just i,j,k)")
+    h.set_defaults(fn=_cmd_health)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -101,6 +101,13 @@ class Task:
             self.total_batches = hparams.batch_count
 
         self.current_batch = 0  # data cursor, persists across intervals
+        # Quarantine skip-list (health guardian): dataset indices excluded
+        # from the training sequence. The cursor walks the SURVIVING sequence
+        # — sorted non-quarantined indices — so every consumer (``batch_at``,
+        # the prefetcher's staging callback, checkpoint-restore cursor math)
+        # agrees on which batch step k maps to.
+        self._quarantined: set = set()
+        self._surviving: Optional[List[int]] = None  # cache, None = dirty
         self.strategies: Dict[int, Strategy] = {}
         self.selected_strategy: Optional[Strategy] = None
         # Device-resident train state from the most recent interval, keyed by
@@ -154,9 +161,60 @@ class Task:
         return self._dataset
 
     def batch_at(self, step: int):
-        """O(1) random access to the batch for global step ``step``."""
-        ds = self.get_dataset()
-        return ds.batch(step % len(ds))
+        """O(1) random access to the batch for global step ``step``,
+        skipping quarantined dataset indices."""
+        return self.get_dataset().batch(self.dataset_index(step))
+
+    # ------------------------------------------------------------- quarantine
+    def quarantine_batches(self, indices) -> None:
+        """Exclude dataset indices from the training sequence (health
+        guardian skip-list). Refuses to quarantine the whole dataset — a
+        job with zero surviving batches is an eviction, not a skip."""
+        add = {int(i) % max(self.epoch_length, 1) for i in indices}
+        if len(self._quarantined | add) >= self.epoch_length:
+            raise ValueError(
+                f"task {self.name}: quarantining {sorted(add)} would leave "
+                "no surviving batches"
+            )
+        self._quarantined |= add
+        self._surviving = None
+
+    def unquarantine_batches(self, indices=None) -> None:
+        """Lift quarantine for ``indices`` (or all, when ``None``)."""
+        if indices is None:
+            self._quarantined.clear()
+        else:
+            self._quarantined -= {int(i) for i in indices}
+        self._surviving = None
+
+    @property
+    def quarantined_batches(self) -> tuple:
+        return tuple(sorted(self._quarantined))
+
+    @property
+    def surviving_epoch_length(self) -> int:
+        """Epoch length after quarantine — the modulus for cursor math."""
+        return self.epoch_length - len(self._quarantined)
+
+    def _surviving_indices(self) -> List[int]:
+        if self._surviving is None:
+            q = self._quarantined
+            self._surviving = [
+                i for i in range(self.epoch_length) if i not in q
+            ]
+        return self._surviving
+
+    def dataset_index(self, step: int) -> int:
+        """Map a cursor step to its dataset index through the skip-list."""
+        if not self._quarantined:
+            return step % max(self.epoch_length, 1)
+        surviving = self._surviving_indices()
+        return surviving[step % len(surviving)]
+
+    def cursor_for_step(self, step: int) -> int:
+        """Normalize a restored global step onto the surviving sequence
+        (checkpoint restore after quarantine replay)."""
+        return step % max(self.surviving_epoch_length, 1)
 
     # ------------------------------------------------------------ checkpoints
     @property
@@ -179,7 +237,7 @@ class Task:
         """Advance the data cursor after an interval ran ``batch_count``
         batches (reference ``Task.py:155-157``)."""
         self.current_batch = (self.current_batch + batch_count) % max(
-            self.epoch_length, 1
+            self.surviving_epoch_length, 1
         )
 
     def select_strategy(self, apportionment: int) -> None:

@@ -16,11 +16,19 @@ without coordination beyond the queue hand-off.
 
 from __future__ import annotations
 
+import logging
 import queue
+import sys
 import threading
 from typing import Any, Callable
 
+log = logging.getLogger("saturn_tpu")
+
 _POLL_S = 0.1
+#: How long ``close()`` waits for the producer thread before declaring it
+#: wedged and abandoning it (it is a daemon; a hung ``stage`` callback must
+#: not hang the interval's unwind path too).
+_CLOSE_JOIN_S = 5.0
 
 #: Sentinel returned by :meth:`DevicePrefetcher.try_next` while the producer
 #: is still staging the next unit — distinct from any staged value and from
@@ -136,21 +144,50 @@ class DevicePrefetcher:
         return val
 
     def close(self) -> None:
-        """Stop the producer and join it (idempotent)."""
+        """Stop the producer, join it with a timeout, and re-raise a pending
+        producer error the consumer never got to see (idempotent).
+
+        The timed join means a WEDGED producer (a ``stage`` callback stuck
+        in I/O) can never hang the interval's unwind path: past the timeout
+        the daemon thread is abandoned with a warning — the hung-dispatch
+        watchdog owns escalation. A pending ``("err", e)`` drained here used
+        to be swallowed; now it re-raises, but only when this close is NOT
+        already unwinding another exception (masking the in-flight error
+        from a ``finally``/``GeneratorExit`` would trade a real traceback
+        for a stale one) and the consumer hasn't already consumed an error
+        for this run.
+        """
         self._closed.set()
-        self._drain()  # a producer blocked on put() can now observe close
-        self._thread.join(timeout=5.0)
+        pending = self._drain()  # a producer blocked on put() can now observe close
+        self._thread.join(timeout=_CLOSE_JOIN_S)
+        if self._thread.is_alive():
+            log.warning(
+                "prefetch producer wedged: not joinable after %.1fs — "
+                "abandoning the daemon thread", _CLOSE_JOIN_S,
+            )
         # The producer may have slipped one last item in between the drain
         # and observing the close flag; drain again now that it is dead so
         # post-close iteration deterministically sees an empty queue.
-        self._drain()
+        pending = self._drain() or pending
+        if (
+            pending is not None
+            and self._taken < self.n          # consumer never saw an error
+            and sys.exc_info()[1] is None     # not unwinding something else
+        ):
+            self._taken = self.n
+            raise pending
 
-    def _drain(self) -> None:
+    def _drain(self):
+        """Empty the queue; returns the first pending producer exception
+        encountered (or None) instead of silently discarding it."""
+        pending = None
         while True:
             try:
-                self._q.get_nowait()
+                tag, val = self._q.get_nowait()
             except queue.Empty:
-                break
+                return pending
+            if tag == "err" and pending is None:
+                pending = val
 
     def __enter__(self) -> "DevicePrefetcher":
         return self

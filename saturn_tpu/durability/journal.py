@@ -108,7 +108,15 @@ def _fsync_dir(path: str) -> None:
 
 def _quarantine_bytes(seg_path: str, offset: int) -> str:
     """Move everything from ``offset`` on into a ``.corrupt`` sidecar and
-    truncate the live segment back to the durable cut."""
+    truncate the live segment back to the durable cut.
+
+    The sidecar gets the same atomic tmp+rename+dir-fsync treatment as
+    segment rotation (``Journal._open_segment``): a crash DURING recovery
+    must never leave a half-written ``.corrupt`` file that a later recovery
+    (or an operator reading the incident) mistakes for the full quarantined
+    tail — a ``.corrupt.tmp`` is deleted on the next pass like any other
+    ``.tmp``.
+    """
     sidecar = seg_path + ".corrupt"
     n = 1
     while os.path.exists(sidecar):
@@ -117,10 +125,13 @@ def _quarantine_bytes(seg_path: str, offset: int) -> str:
     with open(seg_path, "rb") as f:
         f.seek(offset)
         bad = f.read()
-    with open(sidecar, "wb") as f:
+    tmp = sidecar + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(bad)
         f.flush()
         os.fsync(f.fileno())
+    os.replace(tmp, sidecar)
+    _fsync_dir(os.path.dirname(sidecar) or ".")
     with open(seg_path, "r+b") as f:
         f.truncate(offset)
         f.flush()
@@ -165,6 +176,9 @@ def recover(root: str) -> Dict[str, Any]:
                 n += 1
                 sidecar = f"{seg_path}.corrupt.{n}"
             os.replace(seg_path, sidecar)
+            # Make the rename durable like rotation does: a crash here must
+            # not resurrect the quarantined segment under its live name.
+            _fsync_dir(root)
             report["quarantined"].append(sidecar)
             continue
         report["segments"] += 1

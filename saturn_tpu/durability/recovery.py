@@ -68,6 +68,10 @@ class ServiceRecovery:
     last_seq: int = 0
     n_records: int = 0
     incarnations: int = 0
+    # Health-guardian state (task name keyed): quarantined dataset indices
+    # and co-schedule detachments, replayed from health_* records.
+    quarantined: Dict[str, List[int]] = field(default_factory=dict)
+    detached: List[str] = field(default_factory=list)
 
     def live_jobs(self) -> List[JobReplay]:
         return [j for j in self.jobs.values() if not j.terminal]
@@ -84,6 +88,47 @@ class BatchRecovery:
     checkpoints: Dict[str, List[str]] = field(default_factory=dict)
     last_seq: int = 0
     n_records: int = 0
+    quarantined: Dict[str, List[int]] = field(default_factory=dict)
+    detached: List[str] = field(default_factory=list)
+
+
+def fold_health_record(
+    kind: str,
+    d: Dict[str, Any],
+    quarantined: Dict[str, List[int]],
+    detached: List[str],
+) -> bool:
+    """Fold one ``health_*`` journal record into recovery state.
+
+    Shared by both replay paths (and the analysis CLI) so quarantine /
+    detach semantics cannot drift: ``health_quarantine`` unions dataset
+    indices into the task's sorted skip-list, ``health_unquarantine`` with
+    ``indices=None`` clears the task entirely (else subtracts, dropping the
+    key when empty), ``health_detach`` marks the task excluded from future
+    co-schedule groups. Returns True when the record was a health record.
+    """
+    task = d.get("task", "")
+    if kind == "health_quarantine":
+        cur = set(quarantined.get(task, ()))
+        cur.update(int(i) for i in d.get("indices", ()))
+        quarantined[task] = sorted(cur)
+    elif kind == "health_unquarantine":
+        indices = d.get("indices")
+        if indices is None:
+            quarantined.pop(task, None)
+        else:
+            cur = set(quarantined.get(task, ()))
+            cur.difference_update(int(i) for i in indices)
+            if cur:
+                quarantined[task] = sorted(cur)
+            else:
+                quarantined.pop(task, None)
+    elif kind == "health_detach":
+        if task not in detached:
+            detached.append(task)
+    else:
+        return False
+    return True
 
 
 def replay_service_state(root: str) -> ServiceRecovery:
@@ -136,6 +181,8 @@ def replay_service_state(root: str) -> ServiceRecovery:
         elif kind == "ckpt_published":
             task = d.get("task") or d.get("path", "")
             state.checkpoints.setdefault(task, []).append(d.get("path", ""))
+        else:
+            fold_health_record(kind, d, state.quarantined, state.detached)
     return state
 
 
@@ -162,6 +209,8 @@ def replay_batch_state(root: str) -> BatchRecovery:
         elif kind == "ckpt_published":
             task = d.get("task") or d.get("path", "")
             state.checkpoints.setdefault(task, []).append(d.get("path", ""))
+        else:
+            fold_health_record(kind, d, state.quarantined, state.detached)
     return state
 
 

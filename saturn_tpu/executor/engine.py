@@ -172,6 +172,48 @@ def _coschedule_groups(run_tasks, plan) -> List[List]:
     return [g for g in by_root.values() if len(g) >= 2]
 
 
+def _join_with_watchdog(watch, t0, hung, hung_lock, errors, events) -> None:
+    """Join launcher threads under per-thread watchdog deadlines.
+
+    ``watch`` is ``[(thread, member task names, deadline_s | None)]``. A
+    thread still alive past its deadline is ABANDONED: each of its tasks
+    gets a ``HungDispatchError`` recorded on its behalf (the thread itself
+    is wedged — it cannot raise), its completion event fires so dependents
+    unblock, and the engine stops joining the thread. The daemon thread may
+    wake later; every state commit in the launchers is gated on the hung
+    set, so a late wake cannot overwrite this verdict.
+    """
+    from saturn_tpu.health.guardian import HungDispatchError
+
+    pending = list(watch)
+    while pending:
+        for entry in list(pending):
+            th, names, deadline = entry
+            th.join(timeout=0.02)
+            if not th.is_alive():
+                pending.remove(entry)
+                continue
+            if deadline is None:
+                continue
+            elapsed = timeit.default_timer() - t0
+            if elapsed > deadline:
+                logger.error(
+                    "watchdog: abandoning launcher %s after %.1fs "
+                    "(deadline %.1fs) — task(s) %s marked hung",
+                    th.name, elapsed, deadline, names,
+                )
+                with hung_lock:
+                    for name in names:
+                        if name not in hung:
+                            hung.add(name)
+                            errors[name] = HungDispatchError(
+                                name, deadline, elapsed
+                            )
+                for name in names:
+                    events[name].set()
+                pending.remove(entry)
+
+
 def execute(
     run_tasks: Sequence,
     batches: Dict[str, int],
@@ -184,6 +226,7 @@ def execute(
     interval_index: int = 0,
     on_task_start=None,
     on_task_done=None,
+    guardian=None,
 ) -> Dict[str, BaseException]:
     """Gang-execute one interval (reference ``executor.py:88-129``).
 
@@ -221,6 +264,22 @@ def execute(
     cursor advanced. The durability layer journals realized iterations from
     here: a batch count passed to ``on_task_done`` really ran, so a failed
     or preempted attempt never reaches the ledger.
+
+    ``guardian`` (a ``health.TrainingGuardian``) turns on the hung-dispatch
+    watchdog: each launcher thread is deadlined at ``floor + k x`` its
+    profiled window work; past the deadline the engine ABANDONS the thread
+    (records a ``HungDispatchError`` on its task(s), fires their completion
+    events so dependents unblock, stops joining it) and returns. The
+    abandoned daemon thread is gated out of every state commit (cursor
+    advance, ``on_task_done``, error recording) the moment it is declared
+    hung. One benign race remains by design: a launcher that passes the gate
+    and is declared hung DURING its technique's final checkpoint write can
+    leave a newer checkpoint than the rollback target — the retry then
+    resumes slightly ahead and re-trains the difference, which costs
+    makespan, never correctness. With a guardian attached, health faults
+    (``NumericFaultError``/``HungDispatchError``) are also exempt from
+    ``failure_policy="raise"`` — like preemptions, they belong to the
+    caller's recovery policy, not the crash-the-batch path.
     """
     from saturn_tpu.core import distributed
 
@@ -240,6 +299,46 @@ def execute(
     events = {t.name: threading.Event() for t in run_tasks}
     running = {t.name for t in run_tasks}
     errors: Dict[str, BaseException] = {}
+
+    # Hung-dispatch watchdog state: tasks whose launcher was abandoned. Every
+    # error write and post-run commit below is gated on membership, so a
+    # wedged thread that eventually wakes cannot overwrite the watchdog's
+    # verdict or advance state the caller already rolled back.
+    hung: set = set()
+    hung_lock = threading.Lock()
+
+    def _abandoned(name: str) -> bool:
+        with hung_lock:
+            return name in hung
+
+    def _record_error(name: str, e: BaseException) -> None:
+        with hung_lock:
+            if name not in hung:
+                errors[name] = e
+
+    def _stall_then_check(name: str) -> bool:
+        """Apply an injected dispatch stall; True iff this launcher was
+        watchdog-abandoned during the stall (caller must bail without
+        touching task state — the attempt already failed)."""
+        stall = (
+            faults.dispatch_stall_s(name, interval_index)
+            if faults is not None and hasattr(faults, "dispatch_stall_s")
+            else 0.0
+        )
+        if stall > 0.0:
+            logger.warning(
+                "injected dispatch stall: wedging %s for %.1fs", name, stall
+            )
+            _time.sleep(stall)
+        return _abandoned(name)
+
+    def _set_poison(name: str, task) -> None:
+        """Hand the sentinel this interval's observation-level loss poisoning
+        (chaos injection), if any is scheduled for this task."""
+        if faults is not None and hasattr(faults, "numeric_plan"):
+            p = faults.numeric_plan(name, interval_index)
+            if p:
+                task._health_poison = p
 
     abort = threading.Event()
     timers = (
@@ -276,10 +375,19 @@ def execute(
                 "interval: launching %s on block [%d:%d] for %d batches",
                 task.name, a.block.offset, a.block.end, n,
             )
+            if _stall_then_check(task.name):
+                return  # watchdog abandoned this attempt during the stall
+            _set_poison(task.name, task)
             t_run = timeit.default_timer()
             tech.execute(task, devices, tid, override_batch_count=n,
                          **_execute_kwargs(tech, n, window_cap))
             dt_run = timeit.default_timer() - t_run
+            if _abandoned(task.name):
+                logger.warning(
+                    "task %s finished after watchdog abandonment; "
+                    "discarding the attempt", task.name,
+                )
+                return
             if didx and health.any_lost(didx):
                 # chips died under the run: the device state is gone, the
                 # work is discarded — the last checkpoint is ground truth
@@ -293,7 +401,7 @@ def execute(
             if on_task_done is not None:
                 on_task_done(task.name, n)
         except BaseException as e:  # surface after the barrier
-            errors[task.name] = e
+            _record_error(task.name, e)
             if isinstance(e, PreemptedError):
                 logger.warning("%s", e)
             else:
@@ -351,6 +459,9 @@ def execute(
                     t.select_strategy(a.apportionment)
                     if on_task_start is not None:
                         on_task_start(t.name)
+                    if _stall_then_check(t.name):
+                        return  # whole group abandoned during the stall
+                    _set_poison(t.name, t)
                     tech = t.selected_strategy.executor
                     n = batches[t.name]
                     pbt = max(
@@ -380,7 +491,7 @@ def execute(
                         "interleaved": can_interleave,
                     })
                 except BaseException as e:
-                    errors[t.name] = e
+                    _record_error(t.name, e)
                     if isinstance(e, PreemptedError):
                         logger.warning("%s", e)
                     else:
@@ -401,7 +512,7 @@ def execute(
                         m["gen"] = None
                         continue
                     except BaseException as e:
-                        errors[m["task"].name] = e
+                        _record_error(m["task"].name, e)
                         logger.exception(
                             "task %s failed during interval", m["task"].name
                         )
@@ -424,11 +535,13 @@ def execute(
             # Phase 2: blocking finalizations (loss readback, checkpoint),
             # only after ALL members' device work is enqueued.
             for m in drained:
+                if _abandoned(m["task"].name):
+                    continue
                 try:
                     for _ in m["gen"]:
                         pass
                 except BaseException as e:
-                    errors[m["task"].name] = e
+                    _record_error(m["task"].name, e)
                     logger.exception(
                         "task %s failed during interval", m["task"].name
                     )
@@ -450,7 +563,7 @@ def execute(
                         timeit.default_timer() - t_solo
                     ) / max(m["n"], 1)
                 except BaseException as e:
-                    errors[m["task"].name] = e
+                    _record_error(m["task"].name, e)
                     logger.exception(
                         "task %s failed during interval", m["task"].name
                     )
@@ -489,7 +602,7 @@ def execute(
                     if on_task_done is not None:
                         on_task_done(name, m["n"])
                 except BaseException as e:
-                    errors[name] = e
+                    _record_error(name, e)
                     if isinstance(e, PreemptedError):
                         logger.warning("%s", e)
                     else:
@@ -518,24 +631,48 @@ def execute(
     co_groups = _coschedule_groups(run_tasks, plan)
     grouped = {t.name for g in co_groups for t in g}
     tid_of = {t.name: i for i, t in enumerate(run_tasks)}
-    t0 = timeit.default_timer()
-    threads = [
-        threading.Thread(target=launcher, args=(t, i), daemon=True, name=f"launch-{t.name}")
-        for i, t in enumerate(run_tasks)
-        if t.name not in grouped
-    ] + [
-        threading.Thread(
+
+    def _expected_s(t) -> float:
+        """Profiled window work for one task this interval (seconds)."""
+        a = plan.assignments.get(t.name)
+        strat = t.strategies.get(a.apportionment) if a is not None else None
+        pbt = max(float(getattr(strat, "per_batch_time", 0.0) or 0.0), 0.0)
+        return batches.get(t.name, 0) * pbt
+
+    # (thread, member task names, watchdog deadline in seconds). A group
+    # thread's deadline covers the SUM of its members' profiled work — the
+    # members run interleaved on this one thread.
+    watch: List[Tuple[threading.Thread, List[str], Optional[float]]] = []
+    use_watchdog = guardian is not None and guardian.watchdog_enabled
+    for i, t in enumerate(run_tasks):
+        if t.name in grouped:
+            continue
+        th = threading.Thread(
+            target=launcher, args=(t, i), daemon=True, name=f"launch-{t.name}"
+        )
+        dl = guardian.window_deadline_s(_expected_s(t)) if use_watchdog else None
+        watch.append((th, [t.name], dl))
+    for g in co_groups:
+        th = threading.Thread(
             target=group_launcher,
             args=(g, [tid_of[t.name] for t in g]),
             daemon=True,
             name="colaunch-" + "+".join(t.name for t in g),
         )
-        for g in co_groups
-    ]
-    for th in threads:
+        dl = (
+            guardian.window_deadline_s(sum(_expected_s(t) for t in g))
+            if use_watchdog else None
+        )
+        watch.append((th, [t.name for t in g], dl))
+
+    t0 = timeit.default_timer()
+    for th, _, _ in watch:
         th.start()
-    for th in threads:
-        th.join()
+    if use_watchdog:
+        _join_with_watchdog(watch, t0, hung, hung_lock, errors, events)
+    else:
+        for th, _, _ in watch:
+            th.join()
     for tm in timers:
         tm.cancel()
     elapsed = timeit.default_timer() - t0
@@ -559,6 +696,10 @@ def execute(
         real = {
             n: e for n, e in errors.items() if not isinstance(e, PreemptedError)
         }
+        if guardian is not None:
+            # Health faults belong to the guardian's recovery policy
+            # (rollback + backoff), not the crash-the-batch path.
+            real = {n: e for n, e in real.items() if not guardian.owns(e)}
         if real:
             name, err = next(iter(real.items()))
             raise RuntimeError(

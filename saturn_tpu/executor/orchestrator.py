@@ -88,6 +88,8 @@ def orchestrate(
     recovery_policy: str = "pause-resolve-resume",
     replan_degrade_factor: float = 2.0,
     resume_dir: Optional[str] = None,
+    health_guardian=None,
+    crash_barrier=None,
 ) -> dict:
     """Run every task to completion, minimizing batch makespan.
 
@@ -120,6 +122,19 @@ def orchestrate(
     rolled back to the last durable cut), drops journaled-completed tasks,
     subtracts durably realized batches from each survivor's budget, and
     resumes — no durably completed iteration re-runs. Single-host only.
+
+    Training health (``saturn_tpu.health``): a ``TrainingGuardian`` is
+    active by default on single-host runs — the sentinel screens every
+    interval's losses on-device, the engine watchdog deadlines every
+    launcher, and a health fault rolls the task back to its last published
+    checkpoint and retries under exponential backoff (quarantining repeat
+    bad batches, detaching repeat offenders from co-schedule groups,
+    evicting past the per-cause budget). Pass ``health_guardian=False`` to
+    disable, or your own ``TrainingGuardian`` to customize policy. Health
+    transitions are journaled when ``resume_dir`` is set, so kill-replay
+    restores quarantine state. ``crash_barrier`` (a
+    ``resilience.CrashInjector``) is test-only: it threads kill points into
+    the journal and the health recovery path.
 
     Returns ``{"completed": [names], "failed": {name: error string}}``.
     """
@@ -186,6 +201,10 @@ def orchestrate(
 
     journal = None
     ckpt_hook = None
+    recovered_state = None
+    if crash_barrier is not None and resume_dir is None:
+        raise ValueError("crash_barrier requires resume_dir (it instruments "
+                         "the durability journal)")
     if resume_dir is not None:
         if distributed.is_multihost():
             raise ValueError(
@@ -196,8 +215,8 @@ def orchestrate(
         from saturn_tpu.durability import recovery as rmod
         from saturn_tpu.utils import checkpoint as _ckpt
 
-        journal = jmod.Journal(resume_dir)  # recovers torn tails on open
-        state = rmod.replay_batch_state(resume_dir)
+        journal = jmod.Journal(resume_dir, barrier=crash_barrier)  # recovers torn tails on open
+        state = recovered_state = rmod.replay_batch_state(resume_dir)
         if state.plan:
             # Journal-replay audit: the orchestrator always re-solves on
             # resume, but a committed plan the static verifier rejects
@@ -235,39 +254,69 @@ def orchestrate(
 
         _ckpt.add_publish_hook(ckpt_hook)
 
+    # Training-health guardian: on by default single-host. ``False``
+    # disables; a caller-supplied guardian is adopted as-is (its journal is
+    # wired up if it has none and this run is durable).
+    guardian = None
+    if health_guardian is not False and not distributed.is_multihost():
+        from saturn_tpu.health import TrainingGuardian
+
+        guardian = (
+            health_guardian if health_guardian is not None
+            else TrainingGuardian(journal=journal)
+        )
+        if guardian.journal is None and journal is not None:
+            guardian.journal = journal
+        if recovered_state is not None:
+            # Kill-replay: re-apply journaled quarantine skip-lists and
+            # co-schedule detachments to the rebuilt tasks.
+            guardian.restore(
+                getattr(recovered_state, "quarantined", {}) or {},
+                getattr(recovered_state, "detached", ()) or (),
+                task_list,
+            )
+
     try:
         return _orchestrate_loop(
             task_list, topo, interval, threshold, tlimit, failure_policy,
             max_task_retries, metrics_path, trace_dir,
             all_completed, all_failed, retries,
             health_monitor, fault_injector, replanner, journal,
+            guardian,
         )
     finally:
         import sys
 
+        from saturn_tpu.resilience.crash import SimulatedKill
         from saturn_tpu.utils import checkpoint as ckpt
 
         if ckpt_hook is not None:
             ckpt.remove_publish_hook(ckpt_hook)
-        try:
-            # join outstanding async checkpoint writes on EVERY exit path —
-            # a caller catching a failure must still see landed checkpoints
-            ckpt.flush()
-        except Exception:
-            if sys.exc_info()[1] is None:
-                raise  # clean exit: surface the write failure
-            logger.exception(
-                "async checkpoint flush failed during error unwind"
-            )
-        if journal is not None:
-            # Buffered records describe work that really happened
-            # (task_progress only fires post-success), so committing them on
-            # an error unwind is correct; a hard crash skips this and loses
-            # only re-runnable work.
+        # A simulated SIGKILL runs no handlers: no checkpoint flush, no
+        # journal flush/close — buffered records die with the "process",
+        # exactly like the service loop's kill path. Recovery is the next
+        # incarnation's problem (that is the point).
+        if not isinstance(sys.exc_info()[1], SimulatedKill):
             try:
-                journal.close()
+                # join outstanding async checkpoint writes on EVERY other
+                # exit path — a caller catching a failure must still see
+                # landed checkpoints
+                ckpt.flush()
             except Exception:
-                logger.exception("journal close failed during unwind")
+                if sys.exc_info()[1] is None:
+                    raise  # clean exit: surface the write failure
+                logger.exception(
+                    "async checkpoint flush failed during error unwind"
+                )
+            if journal is not None:
+                # Buffered records describe work that really happened
+                # (task_progress only fires post-success), so committing
+                # them on an error unwind is correct; a hard crash skips
+                # this and loses only re-runnable work.
+                try:
+                    journal.close()
+                except Exception:
+                    logger.exception("journal close failed during unwind")
 
 
 def _fold_batch_recovery(task_list, state, all_completed, all_failed) -> List:
@@ -412,6 +461,7 @@ def _orchestrate_loop(
     max_task_retries, metrics_path, trace_dir,
     all_completed, all_failed, retries,
     health=None, faults=None, replanner=None, journal=None,
+    guardian=None,
 ) -> dict:
     from saturn_tpu.core import distributed
     from saturn_tpu.resilience.faults import PreemptedError
@@ -467,8 +517,32 @@ def _orchestrate_loop(
 
         base_topo = topo  # health-monitor indices refer to the pre-fault fleet
         interval_index = 0
+        # Tasks parked by the guardian's exponential backoff: out of the
+        # forecast/re-solve set entirely until their resume interval.
+        parked: List = []
         with ThreadPoolExecutor(max_workers=1, thread_name_prefix="solver") as pool:
-            while task_list:
+            while task_list or parked:
+                if parked:
+                    back = [
+                        t for t in parked
+                        if guardian is None
+                        or not guardian.benched(t.name, interval_index)
+                    ]
+                    if back:
+                        names_back = {t.name for t in back}
+                        parked = [
+                            t for t in parked if t.name not in names_back
+                        ]
+                        task_list.extend(back)
+                        logger.info(
+                            "guardian: backoff expired for %s — re-admitted",
+                            sorted(names_back),
+                        )
+                if not task_list:
+                    # Everyone is benched: burn an idle interval so the
+                    # backoff clock advances.
+                    interval_index += 1
+                    continue
                 if health is not None:
                     # Pre-interval health poll (elastic hook point): apply
                     # scheduled interval-start faults, then consume at most
@@ -497,7 +571,12 @@ def _orchestrate_loop(
                     # overlap next-interval solve with this interval's execution
                     # (``orchestrator.py:69-71``)
                     future = pool.submit(
-                        milp.resolve, remaining, topo, plan, interval, threshold, tlimit
+                        milp.resolve, remaining, topo, plan, interval,
+                        threshold, tlimit,
+                        coschedule_exclude=(
+                            guardian.detached_names() if guardian is not None
+                            else None
+                        ),
                     )
 
                 # Snapshot the EXECUTED plan's assignments before the
@@ -515,7 +594,15 @@ def _orchestrate_loop(
                         health=health, faults=faults,
                         interval_index=interval_index,
                         on_task_done=on_done,
+                        guardian=guardian,
                     )
+                    if guardian is not None:
+                        # Consecutive-fault streaks reset on a clean interval
+                        # (quarantine/detach state persists — corrections,
+                        # not penalties).
+                        for t in run_tasks:
+                            if t.name not in errors:
+                                guardian.note_success(t.name)
                     if journal is not None:
                         journal.barrier("mid-interval",
                                         interval=interval_index)
@@ -643,6 +730,66 @@ def _orchestrate_loop(
                             remaining.append(t)  # was forecast-completed
                     completed = [
                         t for t in completed if t.name not in preempted
+                    ]
+
+                health_errs = (
+                    {n: e for n, e in errors.items() if guardian.owns(e)}
+                    if guardian is not None and errors else {}
+                )
+                if health_errs:
+                    # Guardian path: rollback to the last published
+                    # checkpoint + backoff/quarantine/detach/evict — a ledger
+                    # separate from both preemption and max_task_retries.
+                    errors = {
+                        n: e for n, e in errors.items()
+                        if n not in health_errs
+                    }
+                    by_name = {t.name: t for t in run_tasks}
+                    group_of = plan.coschedule_group_of()
+                    for name, err in sorted(health_errs.items()):
+                        t = by_name[name]
+                        release = getattr(t, "release_live_state", None)
+                        if release is not None:
+                            release()  # poisoned/hung device state is dead
+                        engine.rollback_forecast(t, batches.get(name, 0))
+                        decision = guardian.on_fault(
+                            t, err, interval_index,
+                            in_group=name in group_of,
+                        )
+                        if journal is not None:
+                            # Kill point: quarantine/detach records are
+                            # already durable (guardian journals them with
+                            # an immediate commit) — a kill here must replay
+                            # them on restart.
+                            journal.barrier("post-rollback", task=name,
+                                            interval=interval_index)
+                        if decision.action == "retry":
+                            parked.append(t)
+                            logger.warning(
+                                "task %s health fault (%s, attempt %d) — "
+                                "rolled back, parked for %d interval(s)",
+                                name, decision.cause, decision.attempt,
+                                decision.cooldown,
+                            )
+                        else:
+                            all_failed[name] = repr(err)
+                            if journal is not None:
+                                journal.append("task_failed", task=name,
+                                               error=repr(err))
+                            metrics.event("task_failed", task=name,
+                                          error=repr(err))
+                            logger.error(
+                                "evicting task %s after exhausted health "
+                                "retry budget: %r", name, err,
+                            )
+                            release_c = getattr(t, "release_compiled", None)
+                            if release_c is not None:
+                                release_c()
+                    remaining = [
+                        t for t in remaining if t.name not in health_errs
+                    ]
+                    completed = [
+                        t for t in completed if t.name not in health_errs
                     ]
 
                 if errors:  # "drop": evict failed tasks; "retry": give them

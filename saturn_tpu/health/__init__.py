@@ -1,0 +1,46 @@
+"""Training-health guardian: numeric-fault detection and recovery policy.
+
+Three layers, each usable alone:
+
+- :mod:`~saturn_tpu.health.sentinel` — the numeric-health sentinel. The
+  technique's interval finalization folds the interval's carried per-step
+  losses through one jitted ``lax.scan`` on-device (``jnp.isfinite`` + EWMA
+  spike score); the single host readback the interval already paid now
+  returns the fold report instead of a bare scalar, so detection adds no
+  host sync to the hot path. A non-finite or spiking loss raises a
+  structured :class:`~saturn_tpu.health.sentinel.NumericFaultError`.
+- :mod:`~saturn_tpu.health.guardian` — the engine-level recovery policy.
+  :class:`~saturn_tpu.health.guardian.TrainingGuardian` classifies health
+  faults per (task, cause), rolls the job back to its last published
+  checkpoint (via the caller's ``rollback_forecast``), re-dispatches with
+  exponential backoff under a per-cause retry budget distinct from both the
+  preemption path and ``max_task_retries``, quarantines the offending batch
+  range (a skip-list ``Task.batch_at`` / the ``DevicePrefetcher`` staging
+  path honor), and detaches a repeatedly-faulting member from its
+  co-schedule group. Every transition is journaled (``health_*`` records)
+  so kill-replay restores quarantine state.
+- the hung-dispatch watchdog (also in :mod:`guardian`) — deadlines each
+  task's interval at ``floor + k x profiled window time`` and surfaces a
+  :class:`~saturn_tpu.health.guardian.HungDispatchError` the guardian
+  escalates timeout -> rollback -> evict.
+"""
+
+from saturn_tpu.health.guardian import (
+    GuardianConfig,
+    HungDispatchError,
+    HEALTH_EVENT_CODES,
+    TrainingGuardian,
+)
+from saturn_tpu.health.sentinel import (
+    NumericFaultError,
+    SentinelConfig,
+)
+
+__all__ = [
+    "GuardianConfig",
+    "HEALTH_EVENT_CODES",
+    "HungDispatchError",
+    "NumericFaultError",
+    "SentinelConfig",
+    "TrainingGuardian",
+]

@@ -913,7 +913,10 @@ class SPMDTechnique(BaseTechnique):
             # Data cursor is derived from the trained-step count, so resume
             # is restart-safe (the reference replayed the iterator from the
             # in-memory cursor only, ``Task.py:130-140``).
-            task.current_batch = int(host_state["step"]) % max(task.epoch_length, 1)
+            # cursor_for_step folds the quarantine skip-list into the
+            # modulus, so a restore after quarantine replay lands on the
+            # surviving sequence.
+            task.current_batch = task.cursor_for_step(int(host_state["step"]))
         else:
             state = bundle.init()
 
@@ -974,6 +977,9 @@ class SPMDTechnique(BaseTechnique):
             )
 
         loss = None
+        # Every unit's carried loss stays on-device for the sentinel's
+        # interval-end fold (tiny buffers: one scalar / (K,) per unit).
+        unit_losses: List[Any] = []
         t_all0 = _timeit.default_timer()
         t_steady = t_all0
         # Batch staging runs one unit ahead on the prefetch thread; the
@@ -999,6 +1005,7 @@ class SPMDTechnique(BaseTechnique):
                     state, loss = fused_fn(state, dev_batch)  # loss: (K,)
                 else:
                     state, loss = single_fn(state, dev_batch)
+                unit_losses.append(loss)
                 if u == 0 and len(units) > 1 and not shared:
                     # The first unit still pays one-time warmup (executable
                     # load, constant transfer) plus the un-overlapped first
@@ -1020,11 +1027,95 @@ class SPMDTechnique(BaseTechnique):
             # the harness is rolling back.
             prefetch.close()
         if loss is not None:
-            # ONE host readback per interval — the reliable queue drain
-            # (see utils/timing.py note). A fused window's loss is the (K,)
-            # per-step trajectory; its last entry is the interval's final
-            # loss, identical to what the 1-step path would report.
-            loss_val = float(_dist.host_array(loss).reshape(-1)[-1])
+            from saturn_tpu.health import sentinel as _sentinel
+            from saturn_tpu.utils import metrics as _metrics
+
+            scfg = _sentinel.get_config()
+            poison = task.__dict__.pop("_health_poison", None)
+            rep = None
+            if scfg.enabled:
+                import jax.numpy as jnp
+
+                # Sentinel path: fold the interval's full per-step loss
+                # vector through one jitted on-device scan and read back the
+                # fixed-shape report instead of the bare scalar — STILL one
+                # host readback per interval (the reliable queue drain, see
+                # utils/timing.py note), and the report's last slot is the
+                # same final loss the bare readback returned.
+                losses_vec = jnp.concatenate(
+                    [jnp.reshape(x, (-1,)) for x in unit_losses]
+                )
+                if poison is not None:
+                    ov = _sentinel.poison_overrides(
+                        poison, n, lambda j: task.dataset_index(start + j)
+                    )
+                    if ov is not None:
+                        # Chaos injection corrupts the OBSERVED losses only
+                        # (a device-side scatter); train state is untouched,
+                        # so post-rollback trajectories stay fault-free.
+                        losses_vec = losses_vec.at[ov[0]].set(ov[1])
+                carry = getattr(task, "_sentinel_carry", None)
+                if carry is None:
+                    carry = _sentinel.carry_init()
+                rep = np.asarray(
+                    _dist.host_array(_sentinel.fold(carry, losses_vec, scfg))
+                )
+                loss_val = float(rep[_sentinel.REP_LAST_LOSS])
+            else:
+                # ONE host readback per interval — the reliable queue drain
+                # (see utils/timing.py note). A fused window's loss is the
+                # (K,) per-step trajectory; its last entry is the interval's
+                # final loss, identical to what the 1-step path would report.
+                loss_val = float(_dist.host_array(loss).reshape(-1)[-1])
+            fault = _sentinel.inspect(rep) if rep is not None else None
+            if fault is not None:
+                cause, first_off, bad_count = fault
+                fused_part = n_windows * k
+                if first_off < fused_part:
+                    window = first_off // k
+                else:
+                    window = n_windows + (first_off - fused_part)
+                # Fault path (cold): pull the observed vector and blame the
+                # exact bad steps. Quarantine resolution must be per batch —
+                # blaming the whole K-step window would skip-list healthy
+                # data (and with K == epoch length, the entire dataset). A
+                # finite spike is only locatable via the report's first-bad
+                # slot; non-finite steps are all recoverable host-side.
+                host_losses = np.asarray(
+                    _dist.host_array(losses_vec)
+                ).reshape(-1)
+                bad_offsets = {
+                    int(j) for j in np.flatnonzero(~np.isfinite(host_losses))
+                }
+                if first_off >= 0:
+                    bad_offsets.add(int(first_off))
+                bad_batches = tuple(sorted(
+                    {task.dataset_index(start + j) for j in bad_offsets}
+                ))
+                _metrics.event(
+                    "task_numeric_fault", task=task.name, cause=cause,
+                    window=window, step=first_off, bad_count=bad_count,
+                    batches=list(bad_batches),
+                )
+                log.warning(
+                    "task %s: sentinel tripped (%s) at interval step %d "
+                    "(window %d, %d bad step(s)) — discarding interval",
+                    task.name, cause, first_off, window, bad_count,
+                )
+                # Raised BEFORE realized feedback, the checkpoint write and
+                # the live-state republish: a faulted interval never becomes
+                # durable state, and the engine only advances the cursor
+                # (task.reconfigure) on success — so the last published
+                # checkpoint is the exact rollback target.
+                raise _sentinel.NumericFaultError(
+                    task.name, window, cause, step=first_off,
+                    loss=loss_val, batch_indices=bad_batches,
+                    bad_count=bad_count,
+                )
+            if rep is not None:
+                # Only a healthy interval advances the persisted EWMA carry;
+                # a faulted one discards it with the rest of its state.
+                task._sentinel_carry = rep[:2].copy()
             t_end = _timeit.default_timer()
             elapsed_all = t_end - t_all0
             bs = task.get_dataset().batch_size
@@ -1057,8 +1148,6 @@ class SPMDTechnique(BaseTechnique):
                     # still a clean sample — without it a task scheduled one
                     # batch per interval never gets corrected.
                     task.note_realized_per_batch(per_batch)
-            from saturn_tpu.utils import metrics as _metrics
-
             _metrics.event(
                 "task_interval", task=task.name, technique=self.name,
                 batches=n, loss=loss_val, samples_per_sec=round(sps, 2),

@@ -22,6 +22,14 @@ different shape than it was saved under) lives in
 ``jax.device_put`` against the new sharding spec.
 """
 
+from saturn_tpu.resilience.chaos import (
+    HEALTH_FAULT_CLASSES,
+    CampaignResult,
+    CampaignSpec,
+    campaign_schedule,
+    compare_checkpoints,
+    run_campaign,
+)
 from saturn_tpu.resilience.crash import (
     KILL_POINTS,
     CrashInjector,
@@ -53,4 +61,10 @@ __all__ = [
     "TopologyChange",
     "ElasticReplanner",
     "RECOVERY_POLICIES",
+    "HEALTH_FAULT_CLASSES",
+    "CampaignSpec",
+    "CampaignResult",
+    "campaign_schedule",
+    "run_campaign",
+    "compare_checkpoints",
 ]

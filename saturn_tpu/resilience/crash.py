@@ -42,7 +42,11 @@ from typing import Dict, Optional, Sequence
 logger = logging.getLogger("saturn_tpu")
 
 #: Every barrier a kill can target. The first five are crossed inside
-#: ``Journal.commit``/rotation; the last two are service-loop cuts.
+#: ``Journal.commit``/rotation; the next two are service-loop cuts;
+#: ``post-rollback`` is crossed by the health guardian's recovery path right
+#: after a faulted task was rolled back (its quarantine/detach records are
+#: already durable — the chaos campaign kills here to prove replay restores
+#: them).
 KILL_POINTS = (
     "pre-commit",
     "mid-fsync",
@@ -51,6 +55,7 @@ KILL_POINTS = (
     "post-rename",
     "mid-interval",
     "post-checkpoint",
+    "post-rollback",
 )
 
 

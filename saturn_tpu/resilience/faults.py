@@ -33,8 +33,21 @@ class FaultKind:
     STRAGGLER = "straggler"              # chips slow down by `slowdown`x
     TRIAL_CRASH = "trial_crash"          # one task's interval run raises once
     DEVICE_RETURN = "device_return"      # previously lost chips come back
+    # Health-fault classes (the PR 8 guardian's detection targets). All are
+    # injected at the OBSERVATION level — the sentinel's view of the loss
+    # vector, or a host-side stall before dispatch — never into the train
+    # state, so a rolled-back retry's trajectory is genuinely fault-free.
+    NUMERIC_NAN = "numeric_nan"          # one observed step loss becomes NaN (once)
+    LOSS_SPIKE = "loss_spike"            # one observed step loss explodes (once)
+    BATCH_POISON = "batch_poison"        # dataset indices observe NaN (persistent)
+    DISPATCH_STALL = "dispatch_stall"    # a task's dispatch wedges for stall_s (once)
 
-    ALL = (DEVICE_LOSS, SLICE_PREEMPTION, STRAGGLER, TRIAL_CRASH, DEVICE_RETURN)
+    ALL = (DEVICE_LOSS, SLICE_PREEMPTION, STRAGGLER, TRIAL_CRASH, DEVICE_RETURN,
+           NUMERIC_NAN, LOSS_SPIKE, BATCH_POISON, DISPATCH_STALL)
+    # Kinds targeting ONE task's run (not fleet topology): excluded from
+    # due()/apply_due and consumed through the engine's per-task queries.
+    TASK_LEVEL = (TRIAL_CRASH, NUMERIC_NAN, LOSS_SPIKE, BATCH_POISON,
+                  DISPATCH_STALL)
 
 
 class PreemptedError(RuntimeError):
@@ -59,9 +72,13 @@ class FaultEvent:
     at_interval: int
     kind: str
     devices: Tuple[int, ...] = ()        # device indices (loss/preemption/straggler/return)
-    task: Optional[str] = None           # TRIAL_CRASH target; None = any task
+    task: Optional[str] = None           # task-level target; None = any task
     slowdown: float = 1.0                # STRAGGLER latency multiplier
     after_s: float = 0.0                 # seconds into the interval
+    batches: Tuple[int, ...] = ()        # BATCH_POISON dataset indices
+    step: int = 0                        # NUMERIC_NAN/LOSS_SPIKE interval-step offset
+    stall_s: float = 0.0                 # DISPATCH_STALL wedge duration
+    value: float = float("nan")          # injected loss value (NaN default)
 
     def __post_init__(self) -> None:
         if self.kind not in FaultKind.ALL:
@@ -88,6 +105,8 @@ class FaultInjector:
             self.schedule, key=lambda e: (e.at_interval, e.after_s, e.kind)
         )
         self._consumed_crashes: set = set()
+        self._consumed_numeric: set = set()
+        self._consumed_stalls: set = set()
 
     # ------------------------------------------------------------- interval
     def due(self, interval_index: int, mid_interval: bool = False) -> List[FaultEvent]:
@@ -99,7 +118,7 @@ class FaultInjector:
             for e in self.schedule
             if e.at_interval == interval_index
             and e.mid_interval == mid_interval
-            and e.kind != FaultKind.TRIAL_CRASH
+            and e.kind not in FaultKind.TASK_LEVEL
         ]
 
     def apply_due(self, interval_index: int, monitor, mid_interval: bool = False) -> List[FaultEvent]:
@@ -154,6 +173,58 @@ class FaultInjector:
                 return True
         return False
 
+    # --------------------------------------------------------------- health
+    def numeric_plan(self, task_name: str, interval_index: int) -> Optional[dict]:
+        """The observation-level loss poisoning due for this task's interval
+        run, or None.
+
+        Returns ``{"steps": {offset: value}, "batches": {dataset_idx:
+        value}}`` — the sentinel overwrites those slots in the OBSERVED loss
+        vector before folding. ``numeric_nan`` / ``loss_spike`` events are
+        transient (consumed once; the rolled-back retry is clean), while
+        ``batch_poison`` is persistent from its interval on (the fault
+        follows the dataset index through rollbacks, which is what makes
+        quarantine the fix).
+        """
+        import math
+
+        steps: dict = {}
+        batches: dict = {}
+        for i, e in enumerate(self.schedule):
+            if e.task is not None and e.task != task_name:
+                continue
+            if e.kind in (FaultKind.NUMERIC_NAN, FaultKind.LOSS_SPIKE):
+                if (
+                    e.at_interval == interval_index
+                    and i not in self._consumed_numeric
+                ):
+                    self._consumed_numeric.add(i)
+                    v = e.value
+                    if e.kind == FaultKind.LOSS_SPIKE and math.isnan(v):
+                        v = 1e9  # a spike must stay finite to exercise EWMA
+                    steps[int(e.step)] = float(v)
+            elif e.kind == FaultKind.BATCH_POISON:
+                if interval_index >= e.at_interval:
+                    for b in e.batches:
+                        batches[int(b)] = float(e.value)
+        if not steps and not batches:
+            return None
+        return {"steps": steps, "batches": batches}
+
+    def dispatch_stall_s(self, task_name: str, interval_index: int) -> float:
+        """Seconds this task's dispatch should wedge this interval (0 =
+        none). Consumed once — the watchdog-abandoned retry runs clean."""
+        for i, e in enumerate(self.schedule):
+            if (
+                e.kind == FaultKind.DISPATCH_STALL
+                and e.at_interval == interval_index
+                and (e.task is None or e.task == task_name)
+                and i not in self._consumed_stalls
+            ):
+                self._consumed_stalls.add(i)
+                return float(e.stall_s)
+        return 0.0
+
     # ------------------------------------------------------------------ env
     @classmethod
     def from_env(cls, var: str = "SATURN_TPU_FAULTS") -> Optional["FaultInjector"]:
@@ -195,6 +266,27 @@ def _parse_event(token: str) -> FaultEvent:
         kind = kind.strip()
         if kind == FaultKind.TRIAL_CRASH:
             return FaultEvent(interval, kind, task=spec.strip() or None, after_s=after_s)
+        if kind in (FaultKind.NUMERIC_NAN, FaultKind.LOSS_SPIKE):
+            # spec: task[@step]
+            name, _, step = spec.partition("@")
+            return FaultEvent(
+                interval, kind, task=name.strip() or None,
+                step=int(step) if step else 0, after_s=after_s,
+            )
+        if kind == FaultKind.BATCH_POISON:
+            # spec: task@i,j,k (dataset indices)
+            name, _, idx = spec.partition("@")
+            return FaultEvent(
+                interval, kind, task=name.strip() or None,
+                batches=_parse_devices(idx), after_s=after_s,
+            )
+        if kind == FaultKind.DISPATCH_STALL:
+            # spec: task@seconds
+            name, _, secs = spec.partition("@")
+            return FaultEvent(
+                interval, kind, task=name.strip() or None,
+                stall_s=float(secs) if secs else 5.0, after_s=after_s,
+            )
         if kind == FaultKind.STRAGGLER:
             devs, _, slow = spec.partition("@")
             return FaultEvent(

@@ -77,6 +77,7 @@ class SaturnService:
         durability_dir: Optional[str] = None,
         task_provider=None,
         crash_barrier=None,
+        health_guardian=None,
         poll_s: float = 0.05,
         log: bool = False,
     ):
@@ -137,10 +138,29 @@ class SaturnService:
         self.task_provider = task_provider
         self.killed = False
         self._recovered_plan: Optional[milp.Plan] = None
+        self._recovered_health: Optional[tuple] = None
         if durability_dir is not None:
             self._recover_from(durability_dir, crash_barrier)
         elif crash_barrier is not None:
             raise ValueError("crash_barrier requires durability_dir")
+
+        # Training-health guardian (sentinel policy + hung-dispatch
+        # watchdog). On by default; pass ``health_guardian=False`` to
+        # disable, or a preconfigured TrainingGuardian to tune budgets.
+        self.guardian = None
+        if health_guardian is not False:
+            from saturn_tpu.health import TrainingGuardian
+
+            g = health_guardian
+            if g is None:
+                g = TrainingGuardian(journal=self.journal)
+            elif g.journal is None and self.journal is not None:
+                g.journal = self.journal
+            self.guardian = g
+            if self._recovered_health is not None:
+                quarantined, detached, live_tasks = self._recovered_health
+                g.restore(quarantined, detached, live_tasks)
+        self._recovered_health = None
 
     def _recover_from(self, durability_dir: str, crash_barrier) -> None:
         """Open the journal (rolling torn tails back to the durable cut),
@@ -166,6 +186,15 @@ class SaturnService:
                     # re-journal the terminal record so later incarnations
                     # replay it as terminal directly.
                     self._observe_job("state", rec)
+            if state.quarantined or state.detached:
+                # Replayed health state is re-applied once the guardian is
+                # built (end of __init__) — only live rebuilt tasks carry a
+                # quarantine skip-list (terminal stubs have none).
+                self._recovered_health = (
+                    state.quarantined, state.detached,
+                    [r.task for r in restored
+                     if r.state is JobState.QUEUED],
+                )
             logger.info(
                 "recovery: restored %d job(s) from %s (%d live)",
                 len(restored), durability_dir, len(state.live_jobs()),
@@ -328,6 +357,7 @@ class SaturnService:
 
     def _run_loop(self, topo, tlimit, plan, jobs, interval_index) -> None:
         jnl = self.journal
+        guardian = self.guardian
 
         with metrics.scoped(self.metrics_path):
             self._ready.set()
@@ -389,6 +419,13 @@ class SaturnService:
                         metrics.event("job_evicted", job=rec.job_id,
                                       task=rec.name, reason="cancelled")
                         continue
+                    if guardian is not None and guardian.benched(
+                        rec.name, interval_index
+                    ):
+                        # Health backoff: still cooling down after a fault —
+                        # defer re-admission until its resume interval.
+                        self.queue.requeue(rec)
+                        continue
                     dec = self.admission.admit(rec, topo)
                     if dec.action == ADMIT:
                         jobs[rec.name] = rec
@@ -432,6 +469,8 @@ class SaturnService:
                 candidate = milp.resolve(
                     tasks, topo, plan, self.interval, self.threshold,
                     tlimit, weights=weights,
+                    coschedule_exclude=(guardian.detached_names()
+                                        if guardian is not None else None),
                 )
                 # Mandatory adoption gate (service re-solve path): a
                 # candidate the static verifier rejects is quarantined and
@@ -494,7 +533,12 @@ class SaturnService:
                         faults=self.faults, interval_index=interval_index,
                         on_task_start=self._make_on_start(jobs),
                         on_task_done=self._make_on_done(jobs),
+                        guardian=guardian,
                     )
+                    if guardian is not None:
+                        for t in run_tasks:
+                            if t.name not in errors:
+                                guardian.note_success(t.name)
                     if jnl is not None:
                         # Work ran; its task_progress records are buffered
                         # but NOT yet durable — the canonical lost-progress
@@ -531,6 +575,48 @@ class SaturnService:
                                   error=repr(err))
                     self.queue.requeue(rec)
                 completed = [t for t in completed if t.name not in preempted]
+
+                # 8b. health faults (sentinel / watchdog): the guardian's own
+                #     ledger, NOT charged to the job's max_retries — rollback,
+                #     journal the transition (quarantine/detach records are
+                #     already durable before the barrier), then requeue with
+                #     backoff or evict past the guardian's budget.
+                health_errs: Dict[str, BaseException] = {}
+                if guardian is not None:
+                    health_errs = {n: e for n, e in failed.items()
+                                   if guardian.owns(e)}
+                    failed = {n: e for n, e in failed.items()
+                              if n not in health_errs}
+                group_of = (plan.coschedule_group_of()
+                            if health_errs else {})
+                for name, err in sorted(health_errs.items()):
+                    rec = jobs.pop(name)
+                    self._release(rec.task, compiled=False)
+                    engine.rollback_forecast(rec.task, batches.get(name, 0))
+                    decision = guardian.on_fault(
+                        rec.task, err, interval_index,
+                        in_group=name in group_of,
+                    )
+                    if jnl is not None:
+                        jnl.barrier("post-rollback", task=name,
+                                    interval=interval_index)
+                    if decision.action == "retry":
+                        metrics.event(
+                            "task_health_retry", task=name,
+                            cause=decision.cause, attempt=decision.attempt,
+                            cooldown_intervals=decision.cooldown,
+                        )
+                        self.queue.requeue(rec)
+                    else:
+                        self._release(rec.task, compiled=True)
+                        self.queue.mark(rec, JobState.FAILED,
+                                        error=repr(err))
+                        metrics.event("task_failed", task=name,
+                                      error=repr(err))
+                        metrics.event("job_failed", job=rec.job_id,
+                                      task=name, error=repr(err))
+                completed = [t for t in completed
+                             if t.name not in health_errs]
 
                 # 9. real failures: retry within the job's budget, else FAIL
                 for name, err in sorted(failed.items()):

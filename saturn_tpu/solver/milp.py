@@ -328,6 +328,7 @@ def solve(
     warm: Optional[Plan] = None,
     weights: Optional[Dict[str, float]] = None,
     coschedule_min_gain: float = 1.15,
+    coschedule_exclude=None,
 ) -> Plan:
     """Build and solve the joint strategy/placement/schedule MILP.
 
@@ -360,6 +361,12 @@ def solve(
     measured host fractions) for a pair to enter the co-location term — see
     :func:`coschedule_candidates`. Only the exact MILP proposes co-schedule
     groups; the native/greedy/warm paths stay conservatively serial.
+
+    ``coschedule_exclude``: task names barred from co-location (the health
+    guardian's detached repeat offenders). Exclusion happens at the
+    CANDIDATE level — pairs touching an excluded name never get a ``co``
+    binary — because group members hold overlapping assignments: stripping
+    a member from an already-solved group would be a device race.
     """
     for t in task_list:
         if not t.feasible_strategies():
@@ -478,6 +485,12 @@ def solve(
     # (device phases serialize; host phases hide). Tasks without a measured
     # host fraction produce no candidates, no binaries, no new rows.
     co_pairs = coschedule_candidates(task_list, choices, coschedule_min_gain)
+    if coschedule_exclude:
+        excl = set(coschedule_exclude)
+        co_pairs = [
+            (n1, n2, c) for n1, n2, c in co_pairs
+            if n1 not in excl and n2 not in excl
+        ]
     co_of: Dict[Tuple[str, str], Any] = {}
     eff: Dict[str, Expr] = {n: runtime_expr(n) for n in names}
     per_task_cos: Dict[str, List] = {}
@@ -770,6 +783,7 @@ def resolve(
     time_limit: Optional[float] = None,
     warm_budget_frac: float = 0.25,
     weights: Optional[Dict[str, float]] = None,
+    coschedule_exclude=None,
 ) -> Plan:
     """Introspective re-solve with compare-and-swap (``milp.py:354-444``).
 
@@ -797,7 +811,7 @@ def resolve(
         if warm_schedule(task_list, topology, previous) is not None:
             tl = max(1.0, time_limit * warm_budget_frac)
     fresh = solve(task_list, topology, time_limit=tl, warm=previous,
-                  weights=weights)
+                  weights=weights, coschedule_exclude=coschedule_exclude)
     if previous is None:
         return fresh
 
@@ -828,6 +842,14 @@ def resolve(
             if len(kept := [n for n in grp if n in cur_names]) >= 2
         ],
     )
+    if coschedule_exclude:
+        # A freshly detached member may still sit in the slid plan's groups
+        # (members hold OVERLAPPING assignments, so the group can't just be
+        # stripped) — in that case the fresh plan, solved without the
+        # excluded pairs, is the only valid choice.
+        excl = set(coschedule_exclude)
+        if any(excl & set(grp) for grp in slid.coschedule):
+            return fresh
     slid.compute_dependencies()
     if fresh.makespan < slid.makespan - threshold:
         return fresh

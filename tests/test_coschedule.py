@@ -19,6 +19,7 @@ from saturn_tpu.core.mesh import Block, SliceTopology
 from saturn_tpu.core.strategy import Strategy
 from saturn_tpu.core.technique import BaseTechnique
 from saturn_tpu.executor import engine
+from saturn_tpu.resilience.faults import PreemptedError
 from saturn_tpu.solver.milp import (
     Assignment,
     Plan,
@@ -805,3 +806,76 @@ class TestTrajectoryEquivalence:
         # the neighbor also completed its own 6 steps
         mate = dict(np.load(pair_b.ckpt_path))
         assert int(mate["step"]) == 6
+
+
+# ------------------------------------------------- preemption accounting
+class PreemptOnceTech(GenTech):
+    """GenTech whose injected failure surfaces as a slice preemption, once:
+    the first dispatch of ``victim`` raises ``PreemptedError``; every later
+    attempt runs clean (the task resumed on surviving chips)."""
+
+    def __init__(self, log, victim):
+        super().__init__(log)
+        self.victim = victim
+        self.fired = False
+
+    def interval_dispatches(self, task, devices, tid,
+                            override_batch_count=None, shared=False):
+        if task.name == self.victim and not self.fired:
+            self.fired = True
+            raise PreemptedError(f"slice under {task.name} preempted")
+            yield  # pragma: no cover - marks this as a generator
+        yield from super().interval_dispatches(
+            task, devices, tid,
+            override_batch_count=override_batch_count, shared=shared,
+        )
+
+
+class TestPreemptedGroupMemberAccounting:
+    """PR-8 satellite: a preemption inside a co-schedule group must stay the
+    preempted member's event — the surviving partner keeps its interval, and
+    neither job is charged a retry (losing chips is the fleet's fault)."""
+
+    def test_partner_survives_member_preemption(self):
+        log = []
+        tech = PreemptOnceTech(log, victim="bad")
+        bad = FakeTask("bad", 4, [4], tech)
+        good = FakeTask("good", 4, [4], tech)
+        plan = co_plan(["bad", "good"], co=[["bad", "good"]])
+        errors = engine.execute(
+            [bad, good], {"bad": 4, "good": 4}, 10.0, plan, topo(8),
+            failure_policy="drop",
+        )
+        # the typed error reaches the orchestrator intact — that type is
+        # what routes it to the no-retry-charge requeue path
+        assert set(errors) == {"bad"}
+        assert isinstance(errors["bad"], PreemptedError)
+        assert good.current_batch == 4
+        assert tech.finalized == ["good"]
+        assert bad.current_batch == 0  # nothing realized on the lost member
+
+    def test_preemption_charges_no_retry_budget(self):
+        """End to end with a ZERO retry budget: the contended pair
+        co-schedules, one member is preempted mid-group, and both jobs still
+        complete — a preemption charged to ``max_task_retries`` would have
+        failed the victim outright."""
+        from saturn_tpu.executor.orchestrator import orchestrate
+
+        log = []
+        tech = PreemptOnceTech(log, victim="hosty")
+        hosty = FakeTask("hosty", 12, [4], tech, pbt=0.005, hf=0.8)
+        compy = FakeTask("compy", 12, [4], tech, pbt=0.004, hf=0.0)
+        for t in (hosty, compy):
+            t.hints = {}
+            t.chip_range = None
+        out = orchestrate(
+            [hosty, compy], interval=0.5, topology=topo(4),
+            failure_policy="retry", max_task_retries=0,
+        )
+        assert sorted(out["completed"]) == ["compy", "hosty"]
+        assert out["failed"] == {}
+        assert tech.fired
+        # the partner's batches ran exactly once — its interval was neither
+        # aborted nor rolled back by the groupmate's preemption
+        assert len([u for n, u in log if n == "compy"]) == 12
+        assert len([u for n, u in log if n == "hosty"]) == 12

@@ -14,6 +14,8 @@ Run: ``python tools/lint.py`` — exit 1 only on real findings.
 
 from __future__ import annotations
 
+import ast
+import glob
 import importlib.util
 import json
 import os
@@ -21,6 +23,65 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Packages where a silently swallowed exception eats a training fault the
+#: guardian was supposed to see — the recovery path itself must never lose
+#: an error.
+SWALLOW_ROOTS = ("saturn_tpu/executor", "saturn_tpu/health")
+
+#: A handler that calls one of these (method or bare name) is observing the
+#: failure, not swallowing it: logging, metrics, or an error-ledger write.
+_OBSERVERS = frozenset({
+    "debug", "info", "warning", "error", "exception", "critical",
+    "log", "event", "append", "record", "put", "add",
+})
+
+
+def _observes(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Yield, ast.Continue,
+                             ast.Break)):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name in _OBSERVERS:
+                return True
+        # ``except Exception as e`` whose body reads ``e`` is capturing the
+        # failure into state someone inspects later, not dropping it.
+        if (handler.name and isinstance(node, ast.Name)
+                and node.id == handler.name):
+            return True
+    return False
+
+
+def _swallow_findings(roots=SWALLOW_ROOTS) -> list:
+    """Flag ``except Exception:`` / bare ``except:`` handlers in the
+    executor and health packages whose body neither re-raises, diverts
+    control flow, nor records the failure (log/metric/ledger). Returns
+    ``{"path", "line", "message"}`` dicts; empty means clean."""
+    findings = []
+    for root in roots:
+        for path in sorted(glob.glob(os.path.join(REPO, root, "**", "*.py"),
+                                     recursive=True)):
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                broad = node.type is None or (
+                    isinstance(node.type, ast.Name)
+                    and node.type.id in ("Exception", "BaseException")
+                )
+                if broad and not _observes(node):
+                    findings.append({
+                        "path": os.path.relpath(path, REPO),
+                        "line": node.lineno,
+                        "message": "broad except swallows the error "
+                                   "silently — re-raise, log, or record it",
+                    })
+    return findings
 
 
 def _have(tool: str) -> bool:
@@ -69,6 +130,10 @@ def main() -> int:
         "ok" if not diags else [d.to_json() for d in diags]
     )
     failed |= bool(diags)
+
+    swallows = _swallow_findings()
+    results["swallowed-exceptions"] = "ok" if not swallows else swallows
+    failed |= bool(swallows)
 
     print(json.dumps({"metric": "lint", "results": results,
                       "status": "failed" if failed else "ok"}))
