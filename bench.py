@@ -370,6 +370,10 @@ def main() -> None:
         out["seq_len"] = seq_len
     if probe_cached:
         out["probe_cached"] = True
+    if os.environ.get("SATURN_TPU_TSAN", "") == "1":
+        # Stamp instrumented runs: traced locks/queues perturb the hot path,
+        # so bench_guard refuses to gate on (or record) such a row.
+        out["tsan"] = True
     print(json.dumps(out))
 
 

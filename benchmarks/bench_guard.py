@@ -46,6 +46,8 @@ def latest_record():
         value = parsed.get("value")
         if not isinstance(value, (int, float)) or value <= 0:
             continue
+        if parsed.get("tsan"):
+            continue  # instrumented rows never serve as baselines
         n = int(m.group(1))
         if best is None or n > best[0]:
             best = (n, parsed)
@@ -172,7 +174,24 @@ def main() -> int:
         }))
         return 0
     n, parsed_ref = ref
+    if os.environ.get("SATURN_TPU_TSAN", "") == "1":
+        # The sanitizer's traced locks/queues sit on the measured hot path:
+        # numbers produced under instrumentation are not comparable to (or
+        # recordable as) baselines.
+        print(json.dumps({
+            "metric": "bench_guard", "status": "tsan_instrumented",
+            "reason": "refusing to gate: SATURN_TPU_TSAN=1 instruments "
+                      "the measured hot path",
+        }))
+        return 1
     new = run_bench()
+    if new.get("tsan"):
+        print(json.dumps({
+            "metric": "bench_guard", "status": "tsan_instrumented",
+            "value": new.get("value"),
+            "reason": "bench row was produced under SATURN_TPU_TSAN=1",
+        }))
+        return 1
     try:
         plan_errors = bench_plan_errors(new)
     except Exception as e:

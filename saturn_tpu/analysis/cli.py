@@ -14,6 +14,11 @@ Subcommands:
   would restore.  ``--unquarantine TASK[:i,j,k]`` appends a durable
   ``health_unquarantine`` record (all indices when no list is given) —
   the operator-facing undo for a batch range the guardian skip-listed.
+- ``concurrency [PATH ...]``: saturn-tsan's static pass over the thread
+  mesh — lock-order inversions, unguarded shared state, blocking calls
+  under a lock, condition-wait-without-loop (SAT-C001..C004).  With no
+  paths it audits the five thread-bearing packages (executor, service,
+  durability, data, health) plus utils/metrics.py.
 
 Exit code 0 = no error-severity diagnostics; 1 = at least one error;
 2 = usage/IO failure.  ``--json`` prints the machine-readable report.
@@ -156,11 +161,45 @@ def _cmd_health(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_concurrency(args: argparse.Namespace) -> int:
+    from saturn_tpu.analysis.concurrency import static_pass
+
+    paths = list(args.paths) or static_pass.default_paths()
+    if not paths:
+        print("no paths given and no default audit paths found under cwd "
+              "(run from the repo root, or pass files/directories)",
+              file=sys.stderr)
+        return 2
+    try:
+        result = static_pass.run(paths)
+    except (OSError, SyntaxError) as e:
+        print(f"cannot analyze {paths!r}: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    report = result.report
+    if args.json:
+        payload = report.to_json()
+        # per-code counts + the derived lock-order graph, for tooling
+        counts: dict = {}
+        for d in report.diagnostics:
+            per = counts.setdefault(d.code, {"error": 0, "warning": 0,
+                                             "info": 0})
+            per[d.severity] += 1
+        payload["by_code"] = counts
+        payload["order_edges"] = [
+            {"from": a, "to": b, "where": w}
+            for (a, b), w in sorted(result.edges.items())
+        ]
+        print(json.dumps(payload, sort_keys=True, default=str))
+        return 0 if report.ok else 1
+    return _emit(report, False)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m saturn_tpu.analysis",
-        description="saturn-lint: static plan verifier + JAX hot-path "
-                    "analyzer",
+        description="saturn-lint + saturn-tsan: static plan verifier, JAX "
+                    "hot-path analyzer, and thread-mesh concurrency checks",
     )
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
@@ -190,6 +229,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="append a durable un-quarantine record for TASK "
                         "(all its indices, or just i,j,k)")
     h.set_defaults(fn=_cmd_health)
+
+    c = sub.add_parser(
+        "concurrency",
+        help="saturn-tsan static pass: lock order, shared state, "
+             "blocking-under-lock (SAT-C codes)",
+    )
+    c.add_argument("paths", nargs="*", metavar="PATH",
+                   help="files/directories to analyze (default: the "
+                        "audited thread-mesh packages)")
+    c.set_defaults(fn=_cmd_concurrency)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,53 @@
+"""saturn-tsan: concurrency analysis for the saturn_tpu thread mesh.
+
+Three cooperating pieces, mirroring the saturn-lint layering of PR 7:
+
+- ``static_pass`` — an AST walk over the package that builds a
+  lock-acquisition graph (``with self._lock:`` / ``.acquire()`` patterns,
+  thread roots from ``Thread(target=...)``) and reports SAT-Cxxx
+  diagnostics: lock-order inversions with minimal cycle counterexamples,
+  shared mutable attributes with inconsistent guarding, blocking calls
+  held under a lock, and condition-wait-without-loop.
+- ``sanitizer`` — an opt-in instrumented lock/queue layer
+  (``SATURN_TPU_TSAN=1``) recording real acquisition orders so runtime
+  behaviour can be validated against the static graph.
+- ``interleave`` — a seeded deterministic interleaving scheduler for
+  tests: named preemption points in engine/service/journal hot paths
+  (the crash-harness kill-point pattern) so races reproduce
+  bit-identically by seed.
+
+This module is deliberately import-light (stdlib only at import time):
+product modules on hot paths import the sanitizer factories from here,
+so nothing in this package may import JAX or the wider saturn_tpu tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = [
+    "analyze_paths",
+    "lock",
+    "rlock",
+    "condition",
+    "make_queue",
+    "sched_point",
+]
+
+
+def analyze_paths(paths: Any, *, package_root: Any = None) -> Any:
+    """Run the static concurrency pass over files/directories (lazy import)."""
+    from saturn_tpu.analysis.concurrency import static_pass
+
+    return static_pass.analyze_paths(paths, package_root=package_root)
+
+
+# Re-export the sanitizer factories directly: they are stdlib-only and
+# product modules call them at import time (module-level locks).
+from saturn_tpu.analysis.concurrency.sanitizer import (  # noqa: E402
+    condition,
+    lock,
+    make_queue,
+    rlock,
+)
+from saturn_tpu.analysis.concurrency.interleave import sched_point  # noqa: E402

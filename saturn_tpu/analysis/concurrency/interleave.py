@@ -1,0 +1,213 @@
+"""Seeded deterministic interleaving scheduler for concurrency tests.
+
+The crash harness (``resilience/crash.py``) made crash-recovery testing
+deterministic by naming the interesting instants (kill points) and
+letting a seeded schedule decide which one fires.  This module applies
+the same idea to thread interleavings: product hot paths are annotated
+with named **preemption points** (:func:`sched_point`), and a test
+installs an :class:`InterleaveScheduler` that serializes its *managed*
+threads, choosing at every decision which parked thread runs next with
+``random.Random(seed)`` — same seed, same interleaving, same verdict,
+bit-identically, run after run.
+
+Mechanics
+---------
+- Exactly one managed thread executes at a time; everyone else is
+  parked at a preemption point waiting for a grant.  The coordinator
+  (the thread that calls :meth:`InterleaveScheduler.run`) waits until
+  every managed thread is parked or finished, then grants one parked
+  thread chosen by the seeded RNG.
+- A managed thread only *parks* when it holds no traced locks
+  (:func:`sanitizer.held_locks` is empty) — parking while holding a real
+  lock could deadlock the very threads we are trying to schedule.  At a
+  point reached with locks held the thread records a trace entry and
+  continues; serialization still holds because nobody else is running.
+- Unmanaged threads (anything not started via :meth:`spawn`) pass
+  through :func:`sched_point` untouched, so production code is never
+  affected by a scheduler some test forgot to uninstall.
+- Installing the scheduler also activates the sanitizer
+  (:func:`sanitizer.set_active`), so locks created *inside* the ``with``
+  block come up traced and the held-lock test above works.
+
+When no scheduler is installed, :func:`sched_point` is a single global
+read — cheap enough for the engine/service/journal hot paths it sits in.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from saturn_tpu.analysis.concurrency import sanitizer
+
+__all__ = ["sched_point", "InterleaveScheduler", "SchedulerAborted"]
+
+_SCHED: Optional["InterleaveScheduler"] = None
+
+_TLS = threading.local()
+
+
+def sched_point(name: str) -> None:
+    """Named preemption point; no-op unless an interleaving scheduler is on."""
+    s = _SCHED
+    if s is not None:
+        s.point(name)
+
+
+class SchedulerAborted(BaseException):
+    """Raised inside managed threads when the coordinator gives up.
+
+    Derives from BaseException (like the crash harness's SimulatedKill)
+    so product ``except Exception`` blocks don't swallow the abort.
+    """
+
+
+class InterleaveScheduler:
+    """Seeded serialization of managed threads at named preemption points.
+
+    Usage::
+
+        with InterleaveScheduler(seed=7) as sched:
+            q = SubmissionQueue(...)          # locks come up traced
+            sched.spawn(lambda: q.submit(j), name="producer")
+            sched.spawn(lambda: drain_loop(q), name="service")
+            trace = sched.run()
+    """
+
+    def __init__(self, seed: int, *, timeout: float = 30.0) -> None:
+        self.seed = seed
+        self.timeout = timeout
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()  # raw on purpose: invisible to tracing
+        self._cv = threading.Condition(self._mu)
+        self._states: Dict[str, str] = {}  # name -> running|parked|done
+        self._threads: List[threading.Thread] = []
+        self._errors: Dict[str, BaseException] = {}
+        self._trace: List[str] = []
+        self._abort = False
+        self._prev_active = False
+
+    # -- install / uninstall -------------------------------------------------
+
+    def __enter__(self) -> "InterleaveScheduler":
+        global _SCHED
+        if _SCHED is not None:
+            raise RuntimeError("an InterleaveScheduler is already installed")
+        self._prev_active = sanitizer.enabled()
+        sanitizer.set_active(True)
+        _SCHED = self
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        global _SCHED
+        _SCHED = None
+        sanitizer.set_active(self._prev_active)
+        with self._cv:
+            self._abort = True
+            self._cv.notify_all()
+
+    # -- thread management ---------------------------------------------------
+
+    def spawn(self, fn: Callable[[], Any], *, name: str) -> threading.Thread:
+        """Start ``fn`` on a managed daemon thread parked at an implicit
+        start point (unrecorded, so registration order can't skew the
+        trace)."""
+        if name in self._states:
+            raise ValueError(f"duplicate managed thread name {name!r}")
+
+        def runner() -> None:
+            _TLS.name = name
+            try:
+                self._park(name, point=None)
+                fn()
+            except SchedulerAborted:
+                pass
+            except BaseException as e:  # noqa: BLE001 - surfaced via .errors
+                with self._cv:
+                    self._errors[name] = e
+            finally:
+                with self._cv:
+                    self._states[name] = "done"
+                    self._cv.notify_all()
+
+        with self._cv:
+            self._states[name] = "running"
+        t = threading.Thread(target=runner, name=f"ilv-{name}", daemon=True)
+        self._threads.append(t)
+        t.start()
+        return t
+
+    # -- preemption points ---------------------------------------------------
+
+    def point(self, point_name: str) -> None:
+        name = getattr(_TLS, "name", None)
+        if name is None:
+            return  # unmanaged thread: pass through
+        if sanitizer.held_locks():
+            # Never park holding a real lock.  Append-only trace write is
+            # safe: only one managed thread runs at any moment.
+            with self._cv:
+                self._trace.append(f"{name}@{point_name}+locked")
+            return
+        self._park(name, point=point_name)
+
+    def _park(self, name: str, point: Optional[str]) -> None:
+        deadline = time.monotonic() + self.timeout
+        with self._cv:
+            if point is not None:
+                self._trace.append(f"{name}@{point}")
+            self._states[name] = "parked"
+            self._cv.notify_all()
+            while self._states.get(name) == "parked":
+                if self._abort:
+                    raise SchedulerAborted(name)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    raise SchedulerAborted(f"{name}: no grant in {self.timeout}s")
+
+    # -- coordination --------------------------------------------------------
+
+    def run(self, *, join_timeout: float = 10.0) -> List[str]:
+        """Drive managed threads to completion; return the decision trace.
+
+        Raises the first managed-thread exception (deterministic: thread
+        completion order is scheduler-controlled), or RuntimeError on a
+        stuck mesh (a managed thread neither parks nor finishes).
+        """
+        deadline = time.monotonic() + self.timeout
+        with self._cv:
+            while True:
+                while any(s == "running" for s in self._states.values()):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cv.wait(remaining):
+                        self._abort = True
+                        self._cv.notify_all()
+                        raise RuntimeError(
+                            "interleave scheduler stuck; thread states: "
+                            f"{dict(self._states)}"
+                        )
+                parked = sorted(
+                    n for n, s in self._states.items() if s == "parked"
+                )
+                if not parked:
+                    break  # everyone done
+                pick = parked[self._rng.randrange(len(parked))]
+                self._states[pick] = "running"
+                self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=join_timeout)
+        if self._errors:
+            first = sorted(self._errors)[0]
+            raise self._errors[first]
+        return list(self._trace)
+
+    @property
+    def trace(self) -> List[str]:
+        return list(self._trace)
+
+    @property
+    def errors(self) -> Dict[str, BaseException]:
+        with self._cv:
+            return dict(self._errors)

@@ -1,0 +1,415 @@
+"""Runtime lock-order sanitizer: instrumented lock/queue factories.
+
+Product modules create their synchronisation primitives through the
+factories here instead of calling ``threading.Lock()`` directly::
+
+    from saturn_tpu.analysis import concurrency as tsan
+    self._lock = tsan.rlock("queue.lock")
+
+When tracing is **off** (the default) the factories return the plain
+``threading`` / ``queue`` primitives — zero overhead, identical
+semantics.  When tracing is **on** (``SATURN_TPU_TSAN=1`` in the
+environment, or a deterministic interleaving scheduler is installed by
+:mod:`saturn_tpu.analysis.concurrency.interleave`) they return traced
+wrappers that
+
+- maintain a per-thread stack of held locks,
+- record every *(held → newly acquired)* lock pair into a global
+  :class:`LockOrderRecorder` (the runtime half of the SAT-C001
+  lock-order-inversion check), and
+- flag blocking queue waits performed while holding a lock (the runtime
+  half of SAT-C003).
+
+The tracing decision is taken **at creation time**: a lock created while
+tracing is off stays untraced for its lifetime.  Tests that want traced
+primitives must enable tracing (env var or scheduler) before
+constructing the objects under test.
+
+Lock names are the string literals passed to the factories, so the node
+names in the runtime graph match the node names the static pass derives
+from the same call sites — that is what makes
+:meth:`LockOrderRecorder.validate_against` meaningful.
+
+Stdlib-only; this module sits under every hot-path product module.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple, Union
+
+__all__ = [
+    "lock",
+    "rlock",
+    "condition",
+    "make_queue",
+    "enabled",
+    "set_active",
+    "held_locks",
+    "recorder",
+    "LockOrderRecorder",
+    "TracedLock",
+    "TracedRLock",
+    "TracedCondition",
+    "TracedQueue",
+]
+
+# Flipped by the interleave scheduler (install/uninstall).  Independent of
+# the env var so tests can trace without mutating os.environ.
+_ACTIVE = False
+
+# Per-thread stack of (lock-name, reentry-count) pairs.
+_TLS = threading.local()
+
+
+def enabled() -> bool:
+    """True when newly created primitives should be traced."""
+    return _ACTIVE or os.environ.get("SATURN_TPU_TSAN", "") == "1"
+
+
+def set_active(value: bool) -> None:
+    """Force tracing on/off for subsequently created primitives."""
+    global _ACTIVE
+    _ACTIVE = bool(value)
+
+
+def _stack() -> List[List[Any]]:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = []
+        _TLS.stack = st
+    return st
+
+
+def held_locks() -> Tuple[str, ...]:
+    """Names of traced locks held by the calling thread, outermost first."""
+    return tuple(name for name, _count in _stack())
+
+
+class LockOrderRecorder:
+    """Accumulates observed (held → acquired) lock pairs across threads.
+
+    Thread-safe; the recorder's own lock is a raw ``threading.Lock`` and
+    is deliberately invisible to the tracing machinery.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # (prev, nxt) -> (count, first-witness thread name)
+        self._edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        # lock-name -> names of threads under which a blocking queue wait
+        # happened while the lock was held.
+        self._blocking_under_lock: Dict[str, Set[str]] = {}
+
+    def note(self, prev: str, nxt: str) -> None:
+        tname = threading.current_thread().name
+        with self._mu:
+            count, witness = self._edges.get((prev, nxt), (0, tname))
+            self._edges[(prev, nxt)] = (count + 1, witness)
+
+    def note_blocking_under_lock(self, lock_name: str) -> None:
+        tname = threading.current_thread().name
+        with self._mu:
+            self._blocking_under_lock.setdefault(lock_name, set()).add(tname)
+
+    def edges(self) -> Set[Tuple[str, str]]:
+        with self._mu:
+            return set(self._edges)
+
+    def edge_witness(self, prev: str, nxt: str) -> Optional[str]:
+        with self._mu:
+            hit = self._edges.get((prev, nxt))
+        return hit[1] if hit else None
+
+    def blocking_under_lock(self) -> Dict[str, Set[str]]:
+        with self._mu:
+            return {k: set(v) for k, v in self._blocking_under_lock.items()}
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._blocking_under_lock.clear()
+
+    def cycles(self) -> List[List[str]]:
+        """Minimal cycles in the observed-order graph alone."""
+        return find_cycles(self.edges())
+
+    def validate_against(
+        self, static_pairs: Iterable[Tuple[str, str]]
+    ) -> List[List[str]]:
+        """Cycles in (observed ∪ static) that use ≥1 observed edge.
+
+        A cycle that exists purely in the static graph is the static
+        pass's job to report; this method answers the runtime question
+        "did execution realize an ordering that, combined with orders
+        the code is statically capable of, closes a deadlock cycle?".
+        """
+        observed = self.edges()
+        union: Set[Tuple[str, str]] = set(static_pairs) | observed
+        out: List[List[str]] = []
+        seen: Set[Tuple[str, ...]] = set()
+        for a, b in sorted(observed):
+            cyc = _shortest_cycle_through(union, a, b)
+            if cyc is not None:
+                key = _normalize_cycle(cyc)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(cyc)
+        return out
+
+
+def find_cycles(edges: Iterable[Tuple[str, str]]) -> List[List[str]]:
+    """All distinct minimal cycles, one per participating edge, deduped."""
+    edge_set = set(edges)
+    out: List[List[str]] = []
+    seen: Set[Tuple[str, ...]] = set()
+    for a, b in sorted(edge_set):
+        cyc = _shortest_cycle_through(edge_set, a, b)
+        if cyc is not None:
+            key = _normalize_cycle(cyc)
+            if key not in seen:
+                seen.add(key)
+                out.append(cyc)
+    return out
+
+
+def _shortest_cycle_through(
+    edges: Set[Tuple[str, str]], a: str, b: str
+) -> Optional[List[str]]:
+    """Shortest cycle containing edge a→b: BFS a path b ⇝ a, prepend a→b."""
+    adj: Dict[str, List[str]] = {}
+    for u, v in edges:
+        adj.setdefault(u, []).append(v)
+    for vs in adj.values():
+        vs.sort()
+    if a == b:
+        return [a, a]
+    frontier = [b]
+    parent: Dict[str, str] = {b: b}
+    while frontier:
+        nxt: List[str] = []
+        for u in frontier:
+            for v in adj.get(u, ()):
+                if v in parent:
+                    continue
+                parent[v] = u
+                if v == a:
+                    path = [a]
+                    while path[-1] != b:
+                        path.append(parent[path[-1]])
+                    path.reverse()  # b ... a
+                    return [a] + path  # a, b, ..., a
+                nxt.append(v)
+        frontier = nxt
+    return None
+
+
+def _normalize_cycle(cyc: List[str]) -> Tuple[str, ...]:
+    """Rotation-invariant key for a cycle given as [n0, n1, ..., n0]."""
+    body = cyc[:-1]
+    k = body.index(min(body))
+    return tuple(body[k:] + body[:k])
+
+
+# The process-global recorder.  Traced primitives write here; tests and
+# the CLI read/validate/reset it.
+_RECORDER = LockOrderRecorder()
+
+
+def recorder() -> LockOrderRecorder:
+    return _RECORDER
+
+
+def _note_intent(name: str) -> None:
+    """Record held-lock -> target edges BEFORE attempting the acquire.
+
+    Ordering edges must come from the attempt, not the success: in a real
+    deadlock neither thread's second acquire ever succeeds, and a recorder
+    that only logs completed acquisitions would see no cycle at all.
+    """
+    st = _stack()
+    if st and st[-1][0] == name:
+        return
+    for prev, _count in st:
+        if prev == name:
+            # Re-entrant acquire below other locks: no new ordering edge.
+            return
+        _RECORDER.note(prev, name)
+
+
+def _push(name: str) -> None:
+    st = _stack()
+    if st and st[-1][0] == name:
+        st[-1][1] += 1
+        return
+    for prev, _count in st:
+        if prev == name:
+            st.append([name, 1])
+            return
+    st.append([name, 1])
+
+
+def _pop(name: str) -> None:
+    st = _stack()
+    for i in range(len(st) - 1, -1, -1):
+        if st[i][0] == name:
+            st[i][1] -= 1
+            if st[i][1] == 0:
+                del st[i]
+            return
+
+
+class TracedLock:
+    """threading.Lock wrapper recording acquisition order by name."""
+
+    _factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._inner = self._factory()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _note_intent(self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _push(self.name)
+        return got
+
+    def release(self) -> None:
+        _pop(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "TracedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class TracedRLock(TracedLock):
+    """threading.RLock wrapper recording acquisition order by name."""
+
+    _factory = staticmethod(threading.RLock)
+
+    def locked(self) -> bool:  # RLock has no .locked() before 3.12
+        if self._inner.acquire(blocking=False):
+            self._inner.release()
+            return False
+        return True
+
+
+class TracedCondition:
+    """Condition over a traced lock; wait/notify stay native.
+
+    Built on the traced lock's *underlying* primitive so the stdlib
+    wait/notify machinery operates on a real lock, while enter/exit go
+    through the wrapper to keep the held-stack accurate.
+    """
+
+    def __init__(self, lk: TracedLock, name: str) -> None:
+        self.name = name
+        self._lk = lk
+        self._cond = threading.Condition(lk._inner)
+
+    def __enter__(self) -> "TracedCondition":
+        self._lk.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._lk.release()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._lk.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._lk.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        # wait() releases the lock while blocked: reflect that in the
+        # held stack so other threads' acquisitions don't appear ordered
+        # under a lock nobody holds.
+        _pop(self._lk.name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _push(self._lk.name)
+
+    def wait_for(self, predicate: Any, timeout: Optional[float] = None) -> Any:
+        _pop(self._lk.name)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            _push(self._lk.name)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<TracedCondition {self.name!r} over {self._lk.name!r}>"
+
+
+class TracedQueue(queue.Queue):  # type: ignore[type-arg]
+    """queue.Queue flagging indefinite blocking waits under a held lock."""
+
+    def __init__(self, name: str, maxsize: int = 0) -> None:
+        super().__init__(maxsize)
+        self.name = name
+
+    def _check(self, blocking: bool, timeout: Optional[float]) -> None:
+        if blocking and timeout is None:
+            held = held_locks()
+            if held:
+                _RECORDER.note_blocking_under_lock(held[-1])
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        self._check(block, timeout)
+        return super().get(block, timeout)
+
+    def put(
+        self, item: Any, block: bool = True, timeout: Optional[float] = None
+    ) -> None:
+        self._check(block, timeout)
+        super().put(item, block, timeout)
+
+
+LockLike = Union[threading.Lock, "threading.RLock", TracedLock]  # type: ignore[valid-type]
+
+
+def lock(name: str) -> Any:
+    """A mutex: plain ``threading.Lock`` untraced, ``TracedLock`` traced."""
+    if enabled():
+        return TracedLock(name)
+    return threading.Lock()
+
+
+def rlock(name: str) -> Any:
+    """A re-entrant mutex, traced when the sanitizer is enabled."""
+    if enabled():
+        return TracedRLock(name)
+    return threading.RLock()
+
+
+def condition(lk: Any, name: str) -> Any:
+    """A condition variable over ``lk`` (a value returned by lock/rlock)."""
+    if isinstance(lk, TracedLock):
+        return TracedCondition(lk, name)
+    return threading.Condition(lk)
+
+
+def make_queue(name: str, maxsize: int = 0) -> Any:
+    """A FIFO queue, traced when the sanitizer is enabled."""
+    if enabled():
+        return TracedQueue(name, maxsize)
+    return queue.Queue(maxsize)

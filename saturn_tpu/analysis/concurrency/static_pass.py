@@ -1,0 +1,1358 @@
+"""saturn-tsan static pass: lock-acquisition graph + SAT-C diagnostics.
+
+Walks package ASTs to build a model of the thread mesh — lock objects
+(``threading.Lock/RLock/Condition`` and the sanitizer factories
+``tsan.lock/rlock/condition/make_queue``), thread entry points
+(``threading.Thread(target=...)``), and per-function lock-held contexts
+(``with self._lock:`` nesting plus ``.acquire()``/``.release()`` pairs)
+— then reports:
+
+========== ========= =====================================================
+code       severity  meaning
+========== ========= =====================================================
+SAT-C001   error     lock-order inversion: the static acquisition graph
+                     has a cycle (potential deadlock); counterexample is
+                     the minimal cycle with one witness site per edge
+SAT-C002   error     shared mutable state with no common guard: a class
+                     attribute / closure variable / module global is
+                     mutated under a lock on one path and without it on
+                     another (or mutated lock-free from ≥2 thread roots)
+SAT-C003   error     blocking call (fsync, sleep, Thread.join, blocking
+                     queue get/put, Event.wait) executed while holding a
+                     lock
+SAT-C004   error     Condition.wait() outside a retest loop (lost-wakeup
+                     / spurious-wakeup hazard)
+========== ========= =====================================================
+
+Suppression: a ``# sanctioned-unlocked: <reason>`` comment on the finding
+line (or the line above) downgrades it to ``info`` — the audited case
+stays visible in reports but does not gate.  Placed on a ``def`` line (or
+the line above it), the marker sanctions the whole function: blocking
+calls inside it are audited, and call sites to it stop propagating its
+may-block set (the journal's group-commit ``fsync`` is the canonical
+case — holding the lock across the fsync IS the durability contract).
+
+Heuristics and honest limits (``docs/analysis.md`` has the full policy):
+
+- Interprocedural reasoning follows *resolvable* calls only: methods on
+  ``self``, same-module (or alias-imported analyzed-module) functions,
+  nested siblings, and attributes with a constructor-typed assignment
+  (``self.journal = jmod.Journal(...)``).  Dynamic callables — e.g. the
+  queue's ``observer`` hook — are invisible here; the runtime sanitizer
+  (``SATURN_TPU_TSAN=1``) covers exactly that gap by recording real
+  acquisition orders and validating them against this graph.
+- A method whose every in-tree call site holds lock L is treated as
+  executing under L ("lock-context"); call sites inside ``__init__``
+  count as pre-publication and don't constrain the context.
+- ``with X:`` over an unresolvable name counts as *a* guard when the
+  name looks lock-like (contains ``lock``/``mutex``/``cond``/``_mu``) —
+  such opaque guards satisfy guarding rules but never join the order
+  graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from saturn_tpu.analysis.diagnostics import AnalysisReport, make
+from saturn_tpu.analysis.concurrency.sanitizer import find_cycles
+
+SANCTION_MARKER = "sanctioned-unlocked:"
+
+#: threading constructors → lock kind
+_THREADING_LOCKS = {"Lock": "lock", "RLock": "rlock"}
+#: constructors whose instances are internally synchronized / single-writer
+_SAFE_CTORS = {
+    "Event", "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "Semaphore", "BoundedSemaphore", "Barrier", "local",
+}
+#: attribute calls that mutate their receiver
+_MUTATING_METHODS = {
+    "append", "appendleft", "add", "extend", "insert", "remove", "discard",
+    "pop", "popitem", "popleft", "clear", "update", "setdefault",
+}
+_LOCKISH_HINTS = ("lock", "mutex", "cond", "_mu")
+
+
+def _lockish(name: str) -> bool:
+    low = name.lower()
+    return any(h in low for h in _LOCKISH_HINTS) or low == "mu"
+
+
+# --------------------------------------------------------------------------
+# model
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class LockDef:
+    lock_id: str
+    kind: str                      # "lock" | "rlock"
+    where: str
+
+
+#: lock-context lattice top: "only ever called pre-publication".
+_TOP = frozenset({"<prepub>"})
+
+
+@dataclass
+class Site:
+    """One read/write of a tracked shared variable."""
+
+    fn: "FuncUnit"
+    line: int
+    guards: FrozenSet[str]         # known + opaque lock ids held at the site
+    access: str                    # "write" | "read"
+
+
+@dataclass
+class CallRecord:
+    callee: "FuncUnit"
+    held: FrozenSet[str]
+    line: int
+
+
+@dataclass
+class BlockRecord:
+    op: str                        # "fsync" | "sleep" | "join" | ...
+    held: FrozenSet[str]           # known/opaque locks held at the site
+    line: int
+
+
+@dataclass
+class FuncUnit:
+    qual: str
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef
+    module: "ModuleInfo"
+    cls: Optional["ClassInfo"]
+    parent: Optional["FuncUnit"]
+    is_init: bool = False
+    sanction: Optional[str] = None            # function-level marker reason
+    local_locks: Dict[str, LockDef] = field(default_factory=dict)
+    local_threads: Set[str] = field(default_factory=set)
+    local_queues: Set[str] = field(default_factory=set)
+    local_containers: Set[str] = field(default_factory=set)
+    local_bound: Set[str] = field(default_factory=set)
+    global_decls: Set[str] = field(default_factory=set)
+    nested: Dict[str, "FuncUnit"] = field(default_factory=dict)
+    # populated by the walk:
+    acquires: List[Tuple[str, FrozenSet[str], int]] = field(default_factory=list)
+    calls: List[CallRecord] = field(default_factory=list)
+    blocking: List[BlockRecord] = field(default_factory=list)
+    condwaits: List[Tuple[str, int, bool]] = field(default_factory=list)
+    closure_sites: List[Tuple["FuncUnit", str, Site]] = field(default_factory=list)
+    is_thread_root: bool = False
+    # fixed-point results:
+    may_acquire: Set[str] = field(default_factory=set)
+    may_block: Set[str] = field(default_factory=set)
+    ctx_guards: FrozenSet[str] = _TOP
+
+    def effective(self, held: FrozenSet[str]) -> FrozenSet[str]:
+        ctx = frozenset() if self.ctx_guards == _TOP else self.ctx_guards
+        return held | ctx
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    locks: Dict[str, LockDef] = field(default_factory=dict)     # attr -> def
+    cond_of: Dict[str, str] = field(default_factory=dict)       # cond attr -> lock attr
+    safe_attrs: Set[str] = field(default_factory=set)
+    thread_attrs: Set[str] = field(default_factory=set)
+    attr_types: Dict[str, str] = field(default_factory=dict)    # attr -> class name
+    methods: Dict[str, FuncUnit] = field(default_factory=dict)
+    mutations: Dict[str, List[Site]] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    path: str
+    name: str
+    src_lines: List[str]
+    alias: Dict[str, str] = field(default_factory=dict)          # threading/queue/os/time/tsan
+    mod_alias: Dict[str, str] = field(default_factory=dict)      # local name -> analyzed module short name
+    from_names: Dict[str, str] = field(default_factory=dict)     # bare name -> "threading.Lock" style
+    locks: Dict[str, LockDef] = field(default_factory=dict)      # module-level lock vars
+    global_candidates: Set[str] = field(default_factory=set)
+    global_sites: Dict[str, List[Site]] = field(default_factory=dict)
+    functions: Dict[str, FuncUnit] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    closure_vars: Dict[Tuple[str, str], List[Site]] = field(default_factory=dict)
+
+    def sanction_at(self, line: int) -> Optional[str]:
+        """Marker text on ``line`` (1-indexed) or in the contiguous comment
+        block immediately above it."""
+        if 1 <= line <= len(self.src_lines):
+            text = self.src_lines[line - 1]
+            if SANCTION_MARKER in text:
+                return text.split(SANCTION_MARKER, 1)[1].strip() or "audited"
+        ln = line - 1
+        while 1 <= ln <= len(self.src_lines):
+            text = self.src_lines[ln - 1]
+            if not text.strip().startswith("#"):
+                break
+            if SANCTION_MARKER in text:
+                return text.split(SANCTION_MARKER, 1)[1].strip() or "audited"
+            ln -= 1
+        return None
+
+
+@dataclass
+class ConcurrencyResult:
+    """Everything the pass derived: the report plus the order graph."""
+
+    report: AnalysisReport
+    edges: Dict[Tuple[str, str], str]          # (held, acquired) -> witness
+    locks: Dict[str, LockDef]
+
+    def order_pairs(self) -> Set[Tuple[str, str]]:
+        return set(self.edges)
+
+
+# --------------------------------------------------------------------------
+# per-module collection
+# --------------------------------------------------------------------------
+
+_TSAN_MODULES = {"concurrency", "sanitizer", "tsan"}
+
+
+class _Collector:
+    """Phase 1+2 over one module; defers cross-module work to the linker."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.mod = ModuleInfo(
+            path=path,
+            name=os.path.splitext(os.path.basename(path))[0],
+            src_lines=source.splitlines(),
+        )
+        self.tree = ast.parse(source, filename=path)
+
+    # -------------------------------------------------------------- helpers
+    def _loc(self, node: ast.AST) -> str:
+        return f"{self.mod.path}:{getattr(node, 'lineno', 0)}"
+
+    def _call_root(self, call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(root, attr) for ``root.attr(...)`` or ("", name) for ``name(...)``."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return ("", f.id)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            return (f.value.id, f.attr)
+        return None
+
+    def _classify_ctor(
+        self, node: ast.AST
+    ) -> Optional[Tuple[str, Optional[str], Optional[ast.expr]]]:
+        """(kind, literal-name, underlying-expr) for synchronization ctors.
+
+        kind ∈ lock | rlock | condition | safe | thread | instance:<Class>.
+        """
+        if not isinstance(node, ast.Call):
+            return None
+        root_attr = self._call_root(node)
+        if root_attr is None:
+            return None
+        root, name = root_attr
+        target = None
+        if root == "" and name in self.mod.from_names:
+            target = self.mod.from_names[name]          # "threading.Lock"
+        elif root and self.mod.alias.get(root) in ("threading", "queue"):
+            target = f"{self.mod.alias[root]}.{name}"
+        elif root and self.mod.alias.get(root) == "tsan":
+            lit: Optional[str] = None
+            args = node.args
+            if name in ("lock", "rlock"):
+                if args and isinstance(args[0], ast.Constant):
+                    lit = str(args[0].value)
+                return (name, lit, None)
+            if name == "condition":
+                under = args[0] if args else None
+                for a in args[1:]:
+                    if isinstance(a, ast.Constant):
+                        lit = str(a.value)
+                for kw in node.keywords:
+                    if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                        lit = str(kw.value.value)
+                return ("condition", lit, under)
+            if name == "make_queue":
+                return ("safe", None, None)
+            return None
+        if target:
+            mod, _, ctor = target.partition(".")
+            if mod == "threading" and ctor in _THREADING_LOCKS:
+                return (_THREADING_LOCKS[ctor], None, None)
+            if mod == "threading" and ctor == "Condition":
+                under = node.args[0] if node.args else None
+                return ("condition", None, under)
+            if mod == "threading" and ctor == "Thread":
+                return ("thread", None, None)
+            if ctor in _SAFE_CTORS:
+                return ("safe", None, None)
+            return None
+        # plain ClassName(...) / modalias.ClassName(...): instance typing
+        if root == "" and name[:1].isupper():
+            return (f"instance:{name}", None, None)
+        if root and root in self.mod.mod_alias and name[:1].isupper():
+            return (f"instance:{name}", None, None)
+        return None
+
+    # -------------------------------------------------------------- phase 1
+    def collect(self) -> ModuleInfo:
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                self._collect_import(stmt)
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._collect_module_assign(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_function(stmt, cls=None, parent=None)
+            elif isinstance(stmt, ast.ClassDef):
+                self._collect_class(stmt)
+        return self.mod
+
+    def _collect_import(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                asname = a.asname or a.name.split(".")[0]
+                head = a.name.split(".")[0]
+                if head in ("threading", "queue", "os", "time"):
+                    self.mod.alias[asname] = head
+        elif isinstance(stmt, ast.ImportFrom):
+            src = stmt.module or ""
+            for a in stmt.names:
+                asname = a.asname or a.name
+                if src == "threading":
+                    self.mod.from_names[asname] = f"threading.{a.name}"
+                elif src == "queue":
+                    self.mod.from_names[asname] = f"queue.{a.name}"
+                elif src == "os" and a.name == "fsync":
+                    self.mod.from_names[asname] = "os.fsync"
+                elif src == "time" and a.name == "sleep":
+                    self.mod.from_names[asname] = "time.sleep"
+                elif a.name in _TSAN_MODULES and "analysis" in src:
+                    self.mod.alias[asname] = "tsan"
+                elif src.startswith("saturn_tpu"):
+                    self.mod.mod_alias[asname] = a.name
+
+    def _lock_id(self, scope: str, name: str, lit: Optional[str]) -> str:
+        return lit if lit else f"{self.mod.name}.{scope}{name}"
+
+    def _collect_module_assign(self, stmt: ast.AST) -> None:
+        target: Optional[ast.expr] = None
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target, value = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign):
+            target, value = stmt.target, stmt.value
+        if not isinstance(target, ast.Name) or value is None:
+            return
+        ctor = self._classify_ctor(value)
+        if ctor and ctor[0] in ("lock", "rlock"):
+            lid = self._lock_id("", target.id, ctor[1])
+            self.mod.locks[target.id] = LockDef(lid, ctor[0], self._loc(stmt))
+        elif ctor and ctor[0] == "safe":
+            pass
+        else:
+            self.mod.global_candidates.add(target.id)
+
+    def _collect_class(self, cdef: ast.ClassDef) -> None:
+        cls = ClassInfo(name=cdef.name, module=self.mod)
+        self.mod.classes[cdef.name] = cls
+        # scan every method for self-attr constructor assignments first so
+        # the walk phase knows attribute types regardless of def order
+        for m in cdef.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(m):
+                    if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+                        continue
+                    t = sub.targets[0]
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        continue
+                    ctor = self._classify_ctor(sub.value)
+                    if ctor is None:
+                        continue
+                    kind, lit, under = ctor
+                    if kind in ("lock", "rlock"):
+                        lid = self._lock_id(f"{cdef.name}.", t.attr, lit)
+                        cls.locks[t.attr] = LockDef(lid, kind, self._loc(sub))
+                    elif kind == "condition":
+                        if (
+                            isinstance(under, ast.Attribute)
+                            and isinstance(under.value, ast.Name)
+                            and under.value.id == "self"
+                        ):
+                            cls.cond_of[t.attr] = under.attr
+                        else:
+                            lid = self._lock_id(f"{cdef.name}.", t.attr, lit)
+                            cls.locks[t.attr] = LockDef(lid, "rlock", self._loc(sub))
+                    elif kind == "safe":
+                        cls.safe_attrs.add(t.attr)
+                    elif kind == "thread":
+                        cls.thread_attrs.add(t.attr)
+                    elif kind.startswith("instance:"):
+                        cls.attr_types[t.attr] = kind.split(":", 1)[1]
+        for m in cdef.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fu = self._collect_function(m, cls=cls, parent=None)
+                cls.methods[m.name] = fu
+
+    def _collect_function(
+        self,
+        fdef: ast.AST,
+        cls: Optional[ClassInfo],
+        parent: Optional[FuncUnit],
+    ) -> FuncUnit:
+        assert isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef))
+        bits = [self.mod.name]
+        if cls is not None:
+            bits.append(cls.name)
+        if parent is not None:
+            bits.append(parent.qual.split(".", 1)[1])
+        bits.append(fdef.name)
+        fu = FuncUnit(
+            qual=".".join(bits),
+            node=fdef,
+            module=self.mod,
+            cls=cls if cls is not None else (parent.cls if parent else None),
+            parent=parent,
+            is_init=(fdef.name == "__init__" and cls is not None),
+            sanction=self.mod.sanction_at(fdef.lineno),
+        )
+        args = fdef.args
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            fu.local_bound.add(a.arg)
+        for sub in ast.walk(fdef):
+            if isinstance(sub, ast.Global):
+                fu.global_decls.update(sub.names)
+        self._prescan_locals(fu)
+        if parent is None and cls is None:
+            self.mod.functions[fdef.name] = fu
+        if parent is not None:
+            parent.nested[fdef.name] = fu
+        for stmt in fdef.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_function(stmt, cls=None, parent=fu)
+        return fu
+
+    def _prescan_locals(self, fu: FuncUnit) -> None:
+        """Local bindings: locks, Thread vars, containers (closure-shared)."""
+        assert isinstance(fu.node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        for stmt in fu.node.body:
+            self._prescan_stmt(fu, stmt)
+
+    def _prescan_stmt(self, fu: FuncUnit, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested scopes handled separately
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            value = stmt.value
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    fu.local_bound.add(t.id)
+                    if value is None:
+                        continue
+                    ctor = self._classify_ctor(value)
+                    if ctor and ctor[0] in ("lock", "rlock"):
+                        scope = fu.qual.split(".", 1)[1] + "."
+                        lid = self._lock_id(scope, t.id, ctor[1])
+                        fu.local_locks[t.id] = LockDef(
+                            lid, ctor[0], self._loc(stmt)
+                        )
+                    elif ctor and ctor[0] == "thread":
+                        fu.local_threads.add(t.id)
+                    elif isinstance(
+                        value,
+                        (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.SetComp, ast.ListComp),
+                    ) or (
+                        isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Name)
+                        and value.func.id in ("dict", "list", "set")
+                    ):
+                        fu.local_containers.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            fu.local_bound.add(el.id)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            for el in ast.walk(stmt.target):
+                if isinstance(el, ast.Name):
+                    fu.local_bound.add(el.id)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    for el in ast.walk(item.optional_vars):
+                        if isinstance(el, ast.Name):
+                            fu.local_bound.add(el.id)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._prescan_stmt(fu, child)
+        # comprehension variables, except-as names
+        if isinstance(stmt, ast.Try):
+            for h in stmt.handlers:
+                if h.name:
+                    fu.local_bound.add(h.name)
+
+
+# --------------------------------------------------------------------------
+# the walk: held-lock tracking per function
+# --------------------------------------------------------------------------
+
+
+class _Walker:
+    """Phase 2: per-function statement walk with a held-lock context."""
+
+    def __init__(self, mod: ModuleInfo, registry: "_Registry") -> None:
+        self.mod = mod
+        self.reg = registry
+
+    def walk_module(self) -> None:
+        for fu in _all_funcs(self.mod):
+            assert isinstance(fu.node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            self._walk_body(fu, fu.node.body, tuple(), loop_depth=0)
+
+    # ------------------------------------------------------------- resolve
+    def _resolve_lock(
+        self, fu: FuncUnit, expr: ast.expr
+    ) -> Optional[Tuple[str, str]]:
+        """(lock_id, kind); kind "opaque" for lock-ish unresolvable names."""
+        if isinstance(expr, ast.Name):
+            scope: Optional[FuncUnit] = fu
+            while scope is not None:
+                if expr.id in scope.local_locks:
+                    d = scope.local_locks[expr.id]
+                    return (d.lock_id, d.kind)
+                if expr.id in scope.local_bound:
+                    break  # shadowed by a non-lock local
+                scope = scope.parent
+            if expr.id in self.mod.locks:
+                d = self.mod.locks[expr.id]
+                return (d.lock_id, d.kind)
+            if _lockish(expr.id):
+                return (f"~opaque:{self.mod.name}.{expr.id}", "opaque")
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and fu.cls is not None
+        ):
+            cls = fu.cls
+            if expr.attr in cls.locks:
+                d = cls.locks[expr.attr]
+                return (d.lock_id, d.kind)
+            if expr.attr in cls.cond_of and cls.cond_of[expr.attr] in cls.locks:
+                d = cls.locks[cls.cond_of[expr.attr]]
+                return (d.lock_id, d.kind)
+            if _lockish(expr.attr):
+                return (f"~opaque:{self.mod.name}.{cls.name}.{expr.attr}",
+                        "opaque")
+        return None
+
+    def _resolve_callee(
+        self, fu: FuncUnit, call: ast.Call
+    ) -> Optional[FuncUnit]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            scope: Optional[FuncUnit] = fu
+            while scope is not None:
+                if f.id in scope.nested:
+                    return scope.nested[f.id]
+                scope = scope.parent
+            return self.mod.functions.get(f.id)
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and fu.cls is not None:
+                return fu.cls.methods.get(f.attr)
+            if recv.id in self.mod.mod_alias:
+                target_mod = self.reg.modules.get(self.mod.mod_alias[recv.id])
+                if target_mod is not None:
+                    return target_mod.functions.get(f.attr)
+            return None
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and fu.cls is not None
+        ):
+            tname = fu.cls.attr_types.get(recv.attr)
+            if tname is not None:
+                tcls = self.reg.classes.get(tname)
+                if tcls is not None:
+                    return tcls.methods.get(f.attr)
+        return None
+
+    def _recv_type(self, fu: FuncUnit, recv: ast.expr) -> Optional[str]:
+        """Coarse receiver classification: thread | queue | event | cond."""
+        if isinstance(recv, ast.Name):
+            scope: Optional[FuncUnit] = fu
+            while scope is not None:
+                if recv.id in scope.local_threads:
+                    return "thread"
+                if recv.id in scope.local_bound:
+                    return None
+                scope = scope.parent
+            return None
+        if not (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and fu.cls is not None
+        ):
+            return None
+        cls = fu.cls
+        if recv.attr in cls.thread_attrs:
+            return "thread"
+        if recv.attr in cls.cond_of or (
+            recv.attr in cls.locks and "cond" in recv.attr.lower()
+        ):
+            return "cond"
+        if recv.attr in cls.safe_attrs:
+            return "safe"
+        return None
+
+    # ---------------------------------------------------------------- walk
+    def _walk_body(
+        self,
+        fu: FuncUnit,
+        stmts: Sequence[ast.stmt],
+        held: Tuple[str, ...],
+        loop_depth: int,
+    ) -> Tuple[str, ...]:
+        for stmt in stmts:
+            held = self._walk_stmt(fu, stmt, held, loop_depth)
+        return held
+
+    def _acquire(
+        self, fu: FuncUnit, lock_id: str, kind: str,
+        held: Tuple[str, ...], line: int,
+    ) -> Tuple[str, ...]:
+        if lock_id in held:
+            if kind == "lock":
+                fu.acquires.append((f"{lock_id}!self", frozenset(held), line))
+            return held
+        if kind != "opaque":
+            fu.acquires.append((lock_id, frozenset(held), line))
+        return held + (lock_id,)
+
+    def _walk_stmt(
+        self,
+        fu: FuncUnit,
+        stmt: ast.stmt,
+        held: Tuple[str, ...],
+        loop_depth: int,
+    ) -> Tuple[str, ...]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return held  # nested functions are walked via walk_module
+        if isinstance(stmt, ast.ClassDef):
+            return held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                lk = self._resolve_lock(fu, item.context_expr)
+                if lk is not None:
+                    inner = self._acquire(
+                        fu, lk[0], lk[1], inner, stmt.lineno
+                    )
+                self._scan_expr(fu, item.context_expr, held, loop_depth)
+            self._walk_body(fu, stmt.body, inner, loop_depth)
+            return held
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                self._scan_expr(fu, stmt.test, held, loop_depth)
+            else:
+                self._scan_expr(fu, stmt.iter, held, loop_depth)
+            self._walk_body(fu, stmt.body, held, loop_depth + 1)
+            self._walk_body(fu, stmt.orelse, held, loop_depth)
+            return held
+        if isinstance(stmt, ast.If):
+            self._scan_expr(fu, stmt.test, held, loop_depth)
+            self._walk_body(fu, stmt.body, held, loop_depth)
+            self._walk_body(fu, stmt.orelse, held, loop_depth)
+            return held
+        if isinstance(stmt, ast.Try):
+            self._walk_body(fu, stmt.body, held, loop_depth)
+            for h in stmt.handlers:
+                self._walk_body(fu, h.body, held, loop_depth)
+            self._walk_body(fu, stmt.orelse, held, loop_depth)
+            self._walk_body(fu, stmt.finalbody, held, loop_depth)
+            return held
+        # leaf statements: acquire()/release(), mutations, expression scan
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute) and not call.args:
+                lk = self._resolve_lock(fu, call.func.value)
+                if lk is not None and call.func.attr == "release":
+                    self._scan_expr(fu, call, held, loop_depth)
+                    return tuple(h for h in held if h != lk[0])
+            if (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "acquire"
+            ):
+                lk = self._resolve_lock(fu, call.func.value)
+                if lk is not None:
+                    self._scan_expr(fu, call, held, loop_depth)
+                    return self._acquire(fu, lk[0], lk[1], held, stmt.lineno)
+        self._record_mutations(fu, stmt, held)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(fu, child, held, loop_depth)
+        return held
+
+    # ----------------------------------------------------------- mutations
+    def _note_site(
+        self, fu: FuncUnit, kind: str, key: str, line: int,
+        held: Tuple[str, ...], access: str = "write",
+    ) -> None:
+        site = Site(fn=fu, line=line, guards=frozenset(held), access=access)
+        if kind == "attr" and fu.cls is not None and not fu.is_init:
+            fu.cls.mutations.setdefault(key, []).append(site)
+        elif kind == "global":
+            self.mod.global_sites.setdefault(key, []).append(site)
+        elif kind == "closure":
+            owner = fu.parent
+            while owner is not None:
+                if key in owner.local_containers or key in owner.local_bound:
+                    break
+                owner = owner.parent
+            if owner is not None and key in owner.local_containers:
+                self.mod.closure_vars.setdefault(
+                    (owner.qual, key), []
+                ).append(site)
+
+    def _mutation_target(
+        self, fu: FuncUnit, expr: ast.expr
+    ) -> Optional[Tuple[str, str]]:
+        """("attr"|"global"|"closure", key) for a mutated expression root."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and fu.cls is not None
+        ):
+            a = expr.attr
+            if (
+                a in fu.cls.locks or a in fu.cls.cond_of
+                or a in fu.cls.safe_attrs
+            ):
+                return None
+            return ("attr", a)
+        if isinstance(expr, ast.Name):
+            if expr.id in fu.global_decls:
+                return ("global", expr.id)
+            if expr.id not in fu.local_bound and fu.parent is not None:
+                return ("closure", expr.id)
+            if (
+                expr.id not in fu.local_bound
+                and fu.parent is None
+                and expr.id in self.mod.global_candidates
+            ):
+                return ("global", expr.id)
+        return None
+
+    def _record_mutations(
+        self, fu: FuncUnit, stmt: ast.stmt, held: Tuple[str, ...]
+    ) -> None:
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            base: Optional[ast.expr] = None
+            if isinstance(t, ast.Subscript):
+                base = t.value
+            elif isinstance(t, (ast.Attribute, ast.Name)):
+                base = t
+            if base is None:
+                continue
+            tgt = self._mutation_target(fu, base)
+            if tgt is not None:
+                self._note_site(fu, tgt[0], tgt[1], stmt.lineno, held)
+
+    # --------------------------------------------------------- expressions
+    def _scan_expr(
+        self, fu: FuncUnit, expr: ast.expr, held: Tuple[str, ...],
+        loop_depth: int,
+    ) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._scan_call(fu, node, held, loop_depth)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if (
+                    node.id in self.mod.global_candidates
+                    and node.id not in self._bound_anywhere(fu, node.id)
+                ):
+                    self._note_site(
+                        fu, "global", node.id, node.lineno, held, access="read"
+                    )
+
+    def _bound_anywhere(self, fu: FuncUnit, name: str) -> Set[str]:
+        scope: Optional[FuncUnit] = fu
+        while scope is not None:
+            if name in scope.local_bound and name not in scope.global_decls:
+                return {name}
+            scope = scope.parent
+        return set()
+
+    def _blocking_kind(
+        self, fu: FuncUnit, call: ast.Call
+    ) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            spec = self.mod.from_names.get(f.id)
+            if spec in ("os.fsync", "time.sleep"):
+                return spec.split(".")[1]
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        if isinstance(f.value, ast.Name):
+            root = self.mod.alias.get(f.value.id)
+            if root == "os" and f.attr == "fsync":
+                return "fsync"
+            if root == "time" and f.attr == "sleep":
+                return "sleep"
+        rtype = self._recv_type(fu, f.value)
+        if rtype == "thread" and f.attr == "join":
+            if not _has_timeout(call):
+                return "join"
+            return None
+        if rtype == "safe" and f.attr in ("get", "put"):
+            if _is_blocking_queue_call(call, f.attr):
+                return f"queue.{f.attr}"
+            return None
+        if rtype == "safe" and f.attr == "wait" and not _has_timeout(call):
+            # Event.wait() without a timeout (Condition attrs are "cond")
+            return "event.wait"
+        return None
+
+    def _scan_call(
+        self, fu: FuncUnit, call: ast.Call, held: Tuple[str, ...],
+        loop_depth: int,
+    ) -> None:
+        f = call.func
+        # thread roots
+        ctor = _thread_target(call, self.mod)
+        if ctor is not None:
+            root_fu = self._resolve_target_fn(fu, ctor)
+            if root_fu is not None:
+                root_fu.is_thread_root = True
+        # condition wait-not-in-loop
+        if isinstance(f, ast.Attribute) and f.attr in ("wait", "wait_for"):
+            lk = self._resolve_lock(fu, f.value)
+            is_cond = (
+                isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+                and fu.cls is not None
+                and f.value.attr in fu.cls.cond_of
+            ) or (
+                isinstance(f.value, ast.Name)
+                and lk is not None and "cond" in f.value.id.lower()
+            )
+            if is_cond and f.attr == "wait":
+                fu.condwaits.append(
+                    (lk[0] if lk else "?", call.lineno, loop_depth > 0)
+                )
+        # blocking primitives
+        bk = self._blocking_kind(fu, call)
+        if bk is not None:
+            fu.blocking.append(
+                BlockRecord(op=bk, held=frozenset(held), line=call.lineno)
+            )
+        # resolvable calls -> call graph
+        callee = self._resolve_callee(fu, call)
+        if callee is not None:
+            fu.calls.append(
+                CallRecord(callee=callee, held=frozenset(held),
+                           line=call.lineno)
+            )
+        # mutating method calls on tracked receivers
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATING_METHODS:
+            tgt = self._mutation_target(fu, f.value)
+            if tgt is not None:
+                self._note_site(fu, tgt[0], tgt[1], call.lineno, held)
+
+    def _resolve_target_fn(
+        self, fu: FuncUnit, target: ast.expr
+    ) -> Optional[FuncUnit]:
+        if isinstance(target, ast.Name):
+            scope: Optional[FuncUnit] = fu
+            while scope is not None:
+                if target.id in scope.nested:
+                    return scope.nested[target.id]
+                scope = scope.parent
+            return self.mod.functions.get(target.id)
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and fu.cls is not None
+        ):
+            return fu.cls.methods.get(target.attr)
+        return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is None
+        ):
+            return True
+    return len(call.args) > 0  # join(5.0) / wait(5.0)
+
+
+def _is_blocking_queue_call(call: ast.Call, op: str) -> bool:
+    """q.get()/q.put(item) with block=True (default) and no timeout."""
+    pos_limit = 1 if op == "get" else 2  # beyond: block/timeout positionals
+    if op == "get" and len(call.args) >= 1:
+        return False
+    if op == "put" and len(call.args) >= pos_limit:
+        return False
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is None
+        ):
+            return False
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+    return True
+
+
+def _thread_target(call: ast.Call, mod: ModuleInfo) -> Optional[ast.expr]:
+    f = call.func
+    is_thread = (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and mod.alias.get(f.value.id) == "threading"
+        and f.attr == "Thread"
+    ) or (
+        isinstance(f, ast.Name)
+        and mod.from_names.get(f.id) == "threading.Thread"
+    )
+    if not is_thread:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+def _all_funcs(mod: ModuleInfo) -> List[FuncUnit]:
+    out: List[FuncUnit] = []
+
+    def rec(fu: FuncUnit) -> None:
+        out.append(fu)
+        for n in fu.nested.values():
+            rec(n)
+
+    for f in mod.functions.values():
+        rec(f)
+    for c in mod.classes.values():
+        for m in c.methods.values():
+            rec(m)
+    return out
+
+
+# --------------------------------------------------------------------------
+# linking + fixed points + diagnostics
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Registry:
+    modules: Dict[str, ModuleInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+
+
+def _fixed_points(funcs: List[FuncUnit]) -> None:
+    # may_acquire / may_block: transitive closure over resolved calls
+    for fu in funcs:
+        fu.may_acquire = {
+            lid.split("!", 1)[0] for lid, _h, _l in fu.acquires
+        }
+        fu.may_block = (
+            set() if fu.sanction else {b.op for b in fu.blocking}
+        )
+    changed = True
+    while changed:
+        changed = False
+        for fu in funcs:
+            for cr in fu.calls:
+                add_a = cr.callee.may_acquire - fu.may_acquire
+                if add_a:
+                    fu.may_acquire |= add_a
+                    changed = True
+                if not fu.sanction and not cr.callee.sanction:
+                    add_b = cr.callee.may_block - fu.may_block
+                    if add_b:
+                        fu.may_block |= add_b
+                        changed = True
+    # ctx_guards: meet (intersection) over in-tree call sites; __init__
+    # callers are pre-publication and do not constrain the meet.
+    callers: Dict[int, List[Tuple[FuncUnit, CallRecord]]] = {}
+    for fu in funcs:
+        for cr in fu.calls:
+            callers.setdefault(id(cr.callee), []).append((fu, cr))
+    for fu in funcs:
+        fu.ctx_guards = _TOP
+    changed = True
+    while changed:
+        changed = False
+        for fu in funcs:
+            sites = callers.get(id(fu), [])
+            if fu.is_thread_root:
+                new: FrozenSet[str] = frozenset()
+            elif not sites:
+                # no in-tree callers: external entry point, except __init__
+                # which by definition runs pre-publication
+                new = _TOP if fu.is_init else frozenset()
+            else:
+                acc: Optional[FrozenSet[str]] = None
+                for caller, cr in sites:
+                    if caller.is_init:
+                        continue  # pre-publication: no constraint
+                    if caller.ctx_guards == _TOP:
+                        contrib = cr.held  # prepub chain: held only
+                    else:
+                        contrib = cr.held | caller.ctx_guards
+                    acc = contrib if acc is None else (acc & contrib)
+                new = _TOP if acc is None else acc
+            if new != fu.ctx_guards:
+                fu.ctx_guards = new
+                changed = True
+
+
+def _known(guards: Iterable[str]) -> Set[str]:
+    return {g for g in guards if not g.startswith("~opaque:")}
+
+
+def _reachable_from_roots(funcs: List[FuncUnit]) -> Set[int]:
+    frontier = [f for f in funcs if f.is_thread_root]
+    seen: Set[int] = {id(f) for f in frontier}
+    while frontier:
+        fu = frontier.pop()
+        for cr in fu.calls:
+            if id(cr.callee) not in seen:
+                seen.add(id(cr.callee))
+                frontier.append(cr.callee)
+    return seen
+
+
+def _site_guards(site: Site) -> FrozenSet[str]:
+    return site.fn.effective(site.guards)
+
+
+def _emit_shared_state(
+    report: AnalysisReport,
+    mod: ModuleInfo,
+    what: str,
+    key: str,
+    sites: List[Site],
+    root_reachable: Set[int],
+) -> None:
+    live = [
+        s for s in sites
+        if not (s.fn.ctx_guards == _TOP and not s.guards)  # prepub-only
+    ]
+    writes = [s for s in live if s.access == "write"]
+    if not writes:
+        return
+    guarded = [s for s in live if _site_guards(s)]
+    unguarded = [s for s in live if not _site_guards(s)]
+    flag: List[Site] = []
+    why = ""
+    if guarded and unguarded:
+        flag = unguarded
+        why = "mutated without the guard used elsewhere"
+    elif guarded and not unguarded:
+        common: Set[str] = set(_site_guards(guarded[0]))
+        for s in guarded[1:]:
+            common &= set(_site_guards(s))
+        if not common:
+            flag = guarded
+            why = "sites are guarded by different locks (no common guard)"
+    else:
+        fns = {s.fn.qual for s in writes}
+        rooted = [s for s in writes if id(s.fn) in root_reachable]
+        if len(fns) >= 2 and rooted:
+            flag = writes
+            why = "lock-free mutation reachable from a thread root"
+    guard_names = sorted(
+        {g for s in guarded for g in _site_guards(s)}
+    ) if guarded else []
+    for s in flag:
+        sanction = mod.sanction_at(s.line) or s.fn.sanction
+        sev = "info" if sanction else "error"
+        prefix = f"[sanctioned: {sanction}] " if sanction else ""
+        report.add(make(
+            "SAT-C002", sev,
+            f"{prefix}shared {what} {key!r} {s.access} in {s.fn.qual} "
+            f"without a common guard: {why}",
+            counterexample={
+                "name": key, "access": s.access,
+                "guards_here": sorted(s.guards),
+                "guards_elsewhere": guard_names,
+            },
+            location=f"{mod.path}:{s.line}",
+            category="concurrency",
+        ))
+
+
+def run(
+    paths: Sequence[str], *, package_root: Optional[str] = None
+) -> ConcurrencyResult:
+    """Analyze ``paths`` (files and/or directories) as one program."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for base, _dirs, names in sorted(os.walk(p)):
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(base, n))
+        elif p.endswith(".py"):
+            files.append(p)
+        else:
+            raise OSError(f"not a python file or directory: {p!r}")
+    report = AnalysisReport(subject=f"concurrency:{','.join(paths)}")
+    reg = _Registry()
+    for path in files:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            col = _Collector(path, src)
+        except SyntaxError as e:
+            report.add(make(
+                "SAT-C000", "error", f"cannot parse {path}: {e}",
+                category="concurrency",
+            ))
+            continue
+        mod = col.collect()
+        # module-name collisions (e.g. two __init__.py): suffix to keep both
+        key = mod.name
+        n = 1
+        while key in reg.modules:
+            n += 1
+            key = f"{mod.name}#{n}"
+        reg.modules[key] = mod
+        for cname, cinfo in mod.classes.items():
+            reg.classes.setdefault(cname, cinfo)
+    for mod in reg.modules.values():
+        _Walker(mod, reg).walk_module()
+    funcs: List[FuncUnit] = []
+    for mod in reg.modules.values():
+        funcs.extend(_all_funcs(mod))
+    _fixed_points(funcs)
+    root_reachable = _reachable_from_roots(funcs)
+
+    # ---------------------------------------------------- SAT-C001: ordering
+    edges: Dict[Tuple[str, str], str] = {}
+    all_locks: Dict[str, LockDef] = {}
+    for mod in reg.modules.values():
+        for d in mod.locks.values():
+            all_locks[d.lock_id] = d
+        for c in mod.classes.values():
+            for d in c.locks.values():
+                all_locks[d.lock_id] = d
+        for fu in _all_funcs(mod):
+            for d in fu.local_locks.values():
+                all_locks[d.lock_id] = d
+    for fu in funcs:
+        # direct acquisitions under effective held context
+        for lid, held, line in fu.acquires:
+            if lid.endswith("!self"):
+                base = lid[:-5]
+                d = all_locks.get(base)
+                if d is not None and d.kind == "lock":
+                    sanction = fu.module.sanction_at(line) or fu.sanction
+                    sev = "info" if sanction else "error"
+                    prefix = f"[sanctioned: {sanction}] " if sanction else ""
+                    report.add(make(
+                        "SAT-C001", sev,
+                        f"{prefix}re-acquiring non-reentrant lock "
+                        f"{base!r} already held (self-deadlock)",
+                        counterexample={"cycle": [base, base]},
+                        location=f"{fu.module.path}:{line}",
+                        category="concurrency",
+                    ))
+                continue
+            eff = _known(fu.effective(held))
+            for h in eff:
+                if h != lid:
+                    edges.setdefault((h, lid), f"{fu.module.path}:{line}")
+            # cross-call self-reacquire: every in-tree caller holds this
+            # non-reentrant lock when we acquire it again (the syntactic
+            # same-function case is the "!self" branch above)
+            d = all_locks.get(lid)
+            if (d is not None and d.kind == "lock"
+                    and lid not in held and lid in fu.ctx_guards):
+                sanction = fu.module.sanction_at(line) or fu.sanction
+                sev = "info" if sanction else "error"
+                prefix = f"[sanctioned: {sanction}] " if sanction else ""
+                report.add(make(
+                    "SAT-C001", sev,
+                    f"{prefix}re-acquiring non-reentrant lock {lid!r} "
+                    f"held by every caller of {fu.qual} (self-deadlock)",
+                    counterexample={"cycle": [lid, lid]},
+                    location=f"{fu.module.path}:{line}",
+                    category="concurrency",
+                ))
+        # call-site expansion: held here -> locks the callee may acquire
+        for cr in fu.calls:
+            eff = _known(fu.effective(cr.held))
+            if not eff:
+                continue
+            for lid in cr.callee.may_acquire:
+                if lid in eff or lid.startswith("~opaque:"):
+                    continue
+                for h in eff:
+                    if h != lid:
+                        edges.setdefault(
+                            (h, lid), f"{fu.module.path}:{cr.line}"
+                        )
+    for cyc in find_cycles(set(edges)):
+        pairs = list(zip(cyc, cyc[1:]))
+        report.add(make(
+            "SAT-C001", "error",
+            "lock-order inversion (potential deadlock): "
+            + " -> ".join(cyc),
+            counterexample={
+                "cycle": cyc,
+                "edges": [
+                    {"from": a, "to": b, "where": edges.get((a, b), "?")}
+                    for a, b in pairs
+                ],
+            },
+            location=edges.get(pairs[0], None) if pairs else None,
+            category="concurrency",
+        ))
+
+    # ------------------------------------------------- SAT-C002: shared state
+    for mod in reg.modules.values():
+        for cls in mod.classes.values():
+            for attr, sites in sorted(cls.mutations.items()):
+                _emit_shared_state(
+                    report, mod, f"attribute self.{attr} of {cls.name}",
+                    attr, sites, root_reachable,
+                )
+        for (owner, var), sites in sorted(mod.closure_vars.items()):
+            _emit_shared_state(
+                report, mod, f"closure variable of {owner}", var, sites,
+                root_reachable,
+            )
+        managed = {
+            g for g, sites in mod.global_sites.items()
+            if any(s.access == "write" and _site_guards(s) for s in sites)
+        }
+        for g in sorted(managed):
+            _emit_shared_state(
+                report, mod, f"module global of {mod.name}", g,
+                mod.global_sites[g], root_reachable,
+            )
+
+    # ---------------------------------------------- SAT-C003: blocking calls
+    for fu in funcs:
+        for br in fu.blocking:
+            eff = fu.effective(br.held)
+            if not eff:
+                continue
+            sanction = fu.module.sanction_at(br.line) or fu.sanction
+            sev = "info" if sanction else "error"
+            prefix = f"[sanctioned: {sanction}] " if sanction else ""
+            report.add(make(
+                "SAT-C003", sev,
+                f"{prefix}blocking call ({br.op}) while holding "
+                f"{sorted(eff)} in {fu.qual}",
+                counterexample={"op": br.op, "held": sorted(eff)},
+                location=f"{fu.module.path}:{br.line}",
+                category="concurrency",
+            ))
+        for cr in fu.calls:
+            if not cr.callee.may_block or cr.callee.sanction:
+                continue
+            eff = fu.effective(cr.held)
+            if not eff:
+                continue
+            sanction = fu.module.sanction_at(cr.line) or fu.sanction
+            sev = "info" if sanction else "error"
+            prefix = f"[sanctioned: {sanction}] " if sanction else ""
+            report.add(make(
+                "SAT-C003", sev,
+                f"{prefix}call to {cr.callee.qual} (may block: "
+                f"{sorted(cr.callee.may_block)}) while holding "
+                f"{sorted(eff)} in {fu.qual}",
+                counterexample={
+                    "op": sorted(cr.callee.may_block),
+                    "held": sorted(eff),
+                    "callee": cr.callee.qual,
+                },
+                location=f"{fu.module.path}:{cr.line}",
+                category="concurrency",
+            ))
+
+    # ------------------------------------------ SAT-C004: wait without loop
+    for fu in funcs:
+        for cond_id, line, in_loop in fu.condwaits:
+            if in_loop:
+                continue
+            sanction = fu.module.sanction_at(line) or fu.sanction
+            sev = "info" if sanction else "error"
+            prefix = f"[sanctioned: {sanction}] " if sanction else ""
+            report.add(make(
+                "SAT-C004", sev,
+                f"{prefix}Condition.wait() outside a retest loop in "
+                f"{fu.qual} (lost/spurious wakeup hazard)",
+                counterexample={"condition": cond_id},
+                location=f"{fu.module.path}:{line}",
+                category="concurrency",
+            ))
+
+    report.diagnostics.sort(
+        key=lambda d: (d.code, d.location or "", d.message)
+    )
+    return ConcurrencyResult(report=report, edges=edges, locks=all_locks)
+
+
+def analyze_paths(
+    paths: Sequence[str], *, package_root: Optional[str] = None
+) -> AnalysisReport:
+    return run(paths, package_root=package_root).report
+
+
+#: The thread-mesh surfaces the repo gates on (tools/lint.py, tests).
+AUDITED_PATHS: Tuple[str, ...] = (
+    "saturn_tpu/executor",
+    "saturn_tpu/service",
+    "saturn_tpu/durability",
+    "saturn_tpu/data",
+    "saturn_tpu/health",
+    "saturn_tpu/utils/metrics.py",
+)
+
+
+def default_paths(repo_root: Optional[str] = None) -> List[str]:
+    """The audited package list, resolved against ``repo_root`` (cwd)."""
+    root = repo_root or os.getcwd()
+    out = []
+    for rel in AUDITED_PATHS:
+        cand = os.path.join(root, rel)
+        if os.path.exists(cand):
+            out.append(cand)
+    return out

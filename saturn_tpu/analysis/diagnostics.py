@@ -56,6 +56,29 @@ SAT-J001   error     replayed plan_commit record fails static verification
                      (quarantined, never adopted)
 SAT-J002   error     journal unreadable / plan_commit payload undecodable
 ========== ========= ===========================================================
+
+Concurrency pass (``SAT-C*``) — ``concurrency.static_pass`` (saturn-tsan):
+
+========== ========= ===========================================================
+SAT-C000   error     source file failed to parse (nothing else checked)
+SAT-C001   error     lock-order inversion: cycle in the static acquisition
+                     graph (potential deadlock), or re-acquiring a held
+                     non-reentrant lock (self-deadlock); the counterexample
+                     is the minimal cycle with one witness site per edge
+SAT-C002   error     shared mutable state (class attribute, closure
+                     variable, or lock-managed module global) touched with
+                     no common guard across its mutation sites
+SAT-C003   error     blocking call — fsync, sleep, Thread.join, blocking
+                     queue get/put, Event.wait — executed while holding a
+                     lock (directly or via a resolvable callee)
+SAT-C004   error     Condition.wait() outside a retest loop (lost-wakeup /
+                     spurious-wakeup hazard)
+========== ========= ===========================================================
+
+A ``# sanctioned-unlocked: <reason>`` comment on the finding line, in the
+contiguous comment block above it, or above the enclosing ``def`` (which
+sanctions the whole function) downgrades a SAT-C finding to ``info`` —
+audited cases stay visible but do not gate.
 """
 
 from __future__ import annotations
@@ -69,7 +92,7 @@ from typing import Any, Dict, List, Optional, Tuple
 #: and AOT cache fingerprints (``utils/profile_cache.py``,
 #: ``utils/aot_cache.py``) so a plan repaired under one rule set never reads
 #: back cache entries recorded under another.
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 #: severity levels, weakest to strongest
 SEVERITIES = ("info", "warning", "error")

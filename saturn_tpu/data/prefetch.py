@@ -22,6 +22,8 @@ import sys
 import threading
 from typing import Any, Callable
 
+from saturn_tpu.analysis import concurrency as tsan
+
 log = logging.getLogger("saturn_tpu")
 
 _POLL_S = 0.1
@@ -59,7 +61,9 @@ class DevicePrefetcher:
     def __init__(self, n: int, stage: Callable[[int], Any], depth: int = 2):
         self.n = int(n)
         self._stage = stage
-        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._q: "queue.Queue" = tsan.make_queue(
+            "prefetch.q", maxsize=max(1, int(depth))
+        )
         self._closed = threading.Event()
         self._taken = 0
         self._thread = threading.Thread(

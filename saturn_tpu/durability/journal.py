@@ -43,10 +43,12 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from saturn_tpu.analysis import concurrency as tsan
+from saturn_tpu.analysis.concurrency import sched_point
 
 logger = logging.getLogger("saturn_tpu")
 
@@ -276,7 +278,7 @@ class Journal:
         self.segment_max_bytes = segment_max_bytes
         self.sync = sync
         self._barrier_cb = barrier
-        self._lock = threading.RLock()
+        self._lock = tsan.rlock("journal.lock")
         self._buf: List[bytes] = []
         self._closed = False
         os.makedirs(root, exist_ok=True)
@@ -311,6 +313,8 @@ class Journal:
         rec = dict(body, crc=_crc_of(body))
         return (json.dumps(rec, **_JSON_OPTS) + "\n").encode("utf-8")
 
+    # sanctioned-unlocked: segment creation fsyncs under the journal lock —
+    # the atomic-rotation contract (header durable before rename) requires it
     def _open_segment(self) -> None:
         path = _segment_path(self.root, self._segment_index)
         tmp = path + ".tmp"
@@ -331,6 +335,8 @@ class Journal:
         self._size = os.path.getsize(path)
         self.barrier("post-rename", path=path, segment=self._segment_index)
 
+    # sanctioned-unlocked: rotation flush+fsync under the journal lock is the
+    # durability point that makes the old segment immutable before switching
     def _rotate(self) -> None:
         self.barrier("pre-rotate", path=self._path)
         self._fh.flush()
@@ -344,6 +350,7 @@ class Journal:
     def append(self, kind: str, **data) -> int:
         """Buffer one record; returns its sequence number. NOT durable until
         the next :meth:`commit` — callers choose the group-commit cadence."""
+        sched_point("journal.append")
         with self._lock:
             if self._closed:
                 raise RuntimeError("journal is closed")
@@ -360,9 +367,13 @@ class Journal:
             self.commit()
             return seq
 
+    # sanctioned-unlocked: the fsync under the lock IS the group-commit —
+    # "committed means survives SIGKILL" requires appenders to wait out the
+    # sync rather than interleave records into a half-durable batch
     def commit(self) -> int:
         """Group-commit every buffered record: one write, one fsync.
         Returns the number of records made durable."""
+        sched_point("journal.commit")
         with self._lock:
             if self._closed:
                 raise RuntimeError("journal is closed")
@@ -398,6 +409,8 @@ class Journal:
         with self._lock:
             return len(self._buf)
 
+    # sanctioned-unlocked: final drain — close holds the lock across its
+    # fsync so no append can slip in after the last committed byte
     def close(self) -> None:
         """Commit anything buffered, fsync, close. NOT called on a simulated
         kill — a dead process flushes nothing."""

@@ -19,6 +19,8 @@ import time as _time
 import timeit
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from saturn_tpu.analysis import concurrency as tsan
+from saturn_tpu.analysis.concurrency import sched_point
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.solver.milp import Plan
 from saturn_tpu.utils import metrics
@@ -296,6 +298,7 @@ def execute(
     # flip cannot split one interval across two window policies.
     window_cap = _window_cap()
 
+    sched_point("engine.execute")
     events = {t.name: threading.Event() for t in run_tasks}
     running = {t.name for t in run_tasks}
     errors: Dict[str, BaseException] = {}
@@ -305,16 +308,21 @@ def execute(
     # wedged thread that eventually wakes cannot overwrite the watchdog's
     # verdict or advance state the caller already rolled back.
     hung: set = set()
-    hung_lock = threading.Lock()
+    hung_lock = tsan.lock("engine.hung")
 
     def _abandoned(name: str) -> bool:
         with hung_lock:
             return name in hung
 
-    def _record_error(name: str, e: BaseException) -> None:
+    def _record_error(
+        name: str, e: BaseException, keep_first: bool = False
+    ) -> None:
         with hung_lock:
             if name not in hung:
-                errors[name] = e
+                if keep_first:
+                    errors.setdefault(name, e)
+                else:
+                    errors[name] = e
 
     def _stall_then_check(name: str) -> bool:
         """Apply an injected dispatch stall; True iff this launcher was
@@ -348,6 +356,7 @@ def execute(
     )
 
     def launcher(task, tid: int):
+        sched_point("engine.launcher")
         try:
             for dep in plan.dependencies.get(task.name, ()):
                 if dep in running:
@@ -430,6 +439,7 @@ def execute(
         whose technique lacks generator support runs sequentially on this
         same thread after the interleaved members (correct, unoverlapped).
         """
+        sched_point("engine.group_launcher")
         names = {t.name for t in members}
         active: List[Dict] = []
         t_group0 = timeit.default_timer()
@@ -611,7 +621,9 @@ def execute(
                         )
         except BaseException as e:
             for t in members:
-                errors.setdefault(t.name, e)
+                # keep_first: a member that already recorded its own failure
+                # above keeps it; the group-level error only fills the gaps.
+                _record_error(t.name, e, keep_first=True)
             logger.exception(
                 "co-schedule group %s failed", sorted(names)
             )

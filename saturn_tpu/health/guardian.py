@@ -40,6 +40,7 @@ import os
 from dataclasses import dataclass
 from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Tuple
 
+from saturn_tpu.analysis import concurrency as tsan
 from saturn_tpu.health.sentinel import NumericFaultError
 from saturn_tpu.utils import metrics
 
@@ -128,13 +129,21 @@ class FaultDecision:
 
 
 class TrainingGuardian:
-    """Per-run health policy state. NOT thread-safe by design: every caller
-    (orchestrator loop, service loop) consults it from the single loop
-    thread, after the engine's interval barrier."""
+    """Per-run health policy state.
+
+    Policy *decisions* are made from the single loop thread (orchestrator
+    loop, service loop) after the engine's interval barrier; the streak /
+    bench / detach ledgers are nevertheless guarded by ``_mu`` because
+    read paths (``benched``, ``detached_names``) are reachable from other
+    threads (status endpoints, engine launcher callbacks) and a torn
+    read-modify-write of a streak counter silently mis-counts a fault.
+    The lock is leaf-level: nothing is called while holding it, so it can
+    never participate in a lock-order cycle."""
 
     def __init__(self, config: Optional[GuardianConfig] = None, journal=None):
         self.config = config if config is not None else GuardianConfig.from_env()
         self.journal = journal
+        self._mu = tsan.lock("guardian.lock")
         # (task, cause) -> consecutive faults; cleared by note_success.
         self._streak: Dict[Tuple[str, str], int] = {}
         # task -> consecutive faults of ANY cause (drives group detach).
@@ -179,8 +188,9 @@ class TrainingGuardian:
         quarantine skip-list, and the journal."""
         cause = self.cause_of(err)
         key = (task.name, cause)
-        streak = self._streak[key] = self._streak.get(key, 0) + 1
-        self._total[task.name] = self._total.get(task.name, 0) + 1
+        with self._mu:
+            streak = self._streak[key] = self._streak.get(key, 0) + 1
+            total = self._total[task.name] = self._total.get(task.name, 0) + 1
         code = HEALTH_EVENT_CODES.get(
             "hung_dispatch" if cause == CAUSE_HUNG else "numeric_fault"
         )
@@ -205,7 +215,7 @@ class TrainingGuardian:
         if (
             in_group
             and task.name not in self._detached
-            and self._total[task.name] >= self.config.detach_after
+            and total >= self.config.detach_after
         ):
             self.detach(task.name)
             detached = True
@@ -232,7 +242,9 @@ class TrainingGuardian:
             self.config.backoff_cap,
             max(1, self.config.backoff_base) * (2 ** (streak - 1)),
         )
-        self._benched[task.name] = interval_index + 1 + cooldown
+        resume_at = interval_index + 1 + cooldown
+        with self._mu:
+            self._benched[task.name] = resume_at
         metrics.event(
             "health", code=HEALTH_EVENT_CODES["backoff"], task=task.name,
             cause=cause, attempt=streak, cooldown_intervals=cooldown,
@@ -240,7 +252,7 @@ class TrainingGuardian:
         self._journal(
             "health_backoff", task=task.name, cause=cause, attempt=streak,
             cooldown_intervals=cooldown,
-            resume_interval=self._benched[task.name],
+            resume_interval=resume_at,
         )
         logger.warning(
             "guardian: %s fault #%d on %s — rolled back, retrying after "
@@ -257,9 +269,10 @@ class TrainingGuardian:
     def note_success(self, name: str) -> None:
         """A clean interval resets the consecutive-fault ledgers (quarantine
         and detach state persist — they are corrections, not penalties)."""
-        self._total.pop(name, None)
-        for key in [k for k in self._streak if k[0] == name]:
-            del self._streak[key]
+        with self._mu:
+            self._total.pop(name, None)
+            for key in [k for k in self._streak if k[0] == name]:
+                del self._streak[key]
 
     # ---------------------------------------------------------- quarantine
     def quarantine(self, task: Any, indices: Iterable[int]) -> Tuple[int, ...]:
@@ -291,29 +304,33 @@ class TrainingGuardian:
     def detach(self, name: str) -> None:
         """Exclude the task from co-schedule candidate generation at every
         future (re-)solve."""
-        self._detached.add(name)
+        with self._mu:
+            self._detached.add(name)
         metrics.event(
             "health", code=HEALTH_EVENT_CODES["detach"], task=name,
         )
         self._journal("health_detach", task=name, durable=True)
 
     def detached_names(self) -> FrozenSet[str]:
-        return frozenset(self._detached)
+        with self._mu:
+            return frozenset(self._detached)
 
     # -------------------------------------------------------------- parking
     def benched(self, name: str, interval_index: int) -> bool:
         """Is the task still inside its backoff window? Clears the bench
         entry once the resume interval is reached."""
-        resume = self._benched.get(name)
-        if resume is None:
-            return False
-        if interval_index >= resume:
-            del self._benched[name]
-            return False
-        return True
+        with self._mu:
+            resume = self._benched.get(name)
+            if resume is None:
+                return False
+            if interval_index >= resume:
+                del self._benched[name]
+                return False
+            return True
 
     def resume_interval(self, name: str) -> Optional[int]:
-        return self._benched.get(name)
+        with self._mu:
+            return self._benched.get(name)
 
     # ------------------------------------------------------------- recovery
     def restore(
@@ -335,7 +352,8 @@ class TrainingGuardian:
                     "recovery: re-applied quarantine of %d batch(es) to %s",
                     len(idx), name,
                 )
-        self._detached.update(detached or ())
+        with self._mu:
+            self._detached.update(detached or ())
 
     # -------------------------------------------------------------- journal
     def _journal(self, kind: str, durable: bool = False, **data) -> None:

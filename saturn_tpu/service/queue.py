@@ -21,11 +21,12 @@ State machine (enforced — an illegal transition raises)::
 from __future__ import annotations
 
 import enum
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from saturn_tpu.analysis import concurrency as tsan
+from saturn_tpu.analysis.concurrency import sched_point
 from saturn_tpu.utils import metrics
 
 
@@ -141,8 +142,8 @@ class SubmissionQueue:
     """
 
     def __init__(self, observer=None):
-        self._lock = threading.RLock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = tsan.rlock("queue.lock")
+        self._cond = tsan.condition(self._lock, "queue.cond")
         self._jobs: Dict[str, JobRecord] = {}
         self._arrivals: List[str] = []   # job_ids waiting for the next drain
         self._seq = 0
@@ -168,6 +169,7 @@ class SubmissionQueue:
         name = getattr(request.task, "name", None)
         if not name:
             raise ValueError("JobRequest.task must have a non-empty .name")
+        sched_point("queue.submit")
         with self._lock:
             for rec in self._jobs.values():
                 if rec.name == name and rec.state not in TERMINAL_STATES:
@@ -242,6 +244,7 @@ class SubmissionQueue:
         """Put an admitted job back on the arrival queue (defer, replan drop,
         or preemption). Re-admission is warm: the task keeps its profiled
         strategies, so the controller readmits in O(cache lookup)."""
+        sched_point("queue.requeue")
         with self._lock:
             if rec.state is not JobState.QUEUED:
                 self.mark(rec, JobState.QUEUED)
@@ -253,6 +256,7 @@ class SubmissionQueue:
     def drain(self) -> List[JobRecord]:
         """Take every waiting arrival (FIFO). Called by the server at each
         interval boundary."""
+        sched_point("queue.drain")
         with self._lock:
             ids, self._arrivals = self._arrivals, []
             return [self._jobs[i] for i in ids]
@@ -260,8 +264,14 @@ class SubmissionQueue:
     def wait_for_arrival(self, timeout: Optional[float] = None) -> bool:
         """Block until at least one arrival is waiting (idle-server parking;
         avoids a busy drain loop). Returns whether anything is waiting."""
+        sched_point("queue.wait_for_arrival")
         with self._lock:
             if not self._arrivals:
+                # Invariant: a single *timed* wait, and the return value is
+                # recomputed from _arrivals after waking — spurious wakeups
+                # and lost races surface as a False return the server's poll
+                # loop retries, never as a missed job.
+                # sanctioned-unlocked: timed single wait; caller loop retests
                 self._cond.wait(timeout)
             return bool(self._arrivals)
 
@@ -271,6 +281,7 @@ class SubmissionQueue:
         """Transition a job, stamping timestamps. Illegal transitions raise
         — a state-machine violation is a server bug, not a runtime condition
         to paper over."""
+        sched_point("queue.mark")
         with self._lock:
             if state not in _TRANSITIONS[rec.state]:
                 raise RuntimeError(
@@ -346,6 +357,7 @@ class SubmissionQueue:
         """Request cancellation. A still-QUEUED job is evicted immediately;
         an admitted job is flagged and the server evicts it at the next
         interval boundary. Returns False if the job is already terminal."""
+        sched_point("queue.cancel")
         with self._lock:
             rec = self.get(job_id)
             if rec.state in TERMINAL_STATES:

@@ -30,6 +30,7 @@ import time
 import timeit
 from typing import Any, Dict, List, Optional
 
+from saturn_tpu.analysis.concurrency import sched_point
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.executor import engine
 from saturn_tpu.executor.orchestrator import (
@@ -362,6 +363,7 @@ class SaturnService:
         with metrics.scoped(self.metrics_path):
             self._ready.set()
             while True:
+                sched_point("service.interval")
                 if self._stop.is_set():
                     if self._abort.is_set():
                         for rec in list(jobs.values()):

@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Optional
 
+from saturn_tpu.analysis import concurrency as tsan
+
 logger = logging.getLogger("saturn_tpu")
 
 
@@ -47,7 +49,7 @@ class MetricsWriter:
         self.path = path
         self.max_buffered = max(1, int(max_buffered))
         self.max_latency_s = float(max_latency_s)
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("metrics.writer")
         self._fh = open(path, "a")
         self._buf: list = []
         self._oldest: Optional[float] = None  # monotonic ts of _buf[0]
@@ -102,6 +104,9 @@ class MetricsWriter:
             if not self._fh.closed:
                 try:
                     self._fh.flush()
+                    # sanctioned-unlocked: close IS the rotation durability
+                    # point; fsync under the lock keeps late event() callers
+                    # from interleaving appends into a half-synced stream.
                     os.fsync(self._fh.fileno())
                 except (OSError, ValueError):
                     pass
@@ -109,7 +114,7 @@ class MetricsWriter:
 
 
 _WRITER: Optional[MetricsWriter] = None
-_CONF_LOCK = threading.Lock()
+_CONF_LOCK = tsan.lock("metrics.conf")
 
 
 def configure(path: Optional[str]) -> None:
@@ -123,6 +128,11 @@ def configure(path: Optional[str]) -> None:
 
 def event(kind: str, **fields) -> None:
     """Emit an event if metrics are configured; no-op otherwise."""
+    # Invariant: _WRITER swaps are atomic (one assignment under _CONF_LOCK)
+    # and a stale writer is drained-then-closed, where event() degrades to
+    # a documented drop (see MetricsWriter.event) — taking _CONF_LOCK here
+    # would put a mutex acquisition on every hot-path emission.
+    # sanctioned-unlocked: single-reference read of a lock-managed global
     w = _WRITER
     if w is not None:
         w.event(kind, **fields)
@@ -133,6 +143,7 @@ def flush() -> None:
     off. Called at interval boundaries (engine, orchestrator, service loop)
     so telemetry lands off the step critical path but before the next
     interval's work starts."""
+    # sanctioned-unlocked: same single-reference-read contract as event()
     w = _WRITER
     if w is not None:
         w.flush()

@@ -135,6 +135,18 @@ def main() -> int:
     results["swallowed-exceptions"] = "ok" if not swallows else swallows
     failed |= bool(swallows)
 
+    # saturn-tsan: the concurrency pass over the thread-bearing packages.
+    # Gates on unsanctioned SAT-C findings (errors); sanctioned cases are
+    # info-severity and pass.
+    from saturn_tpu.analysis.concurrency import static_pass
+
+    tsan_report = static_pass.run(static_pass.default_paths(REPO)).report
+    results["saturn-tsan"] = (
+        "ok" if tsan_report.ok
+        else [d.to_json() for d in tsan_report.errors]
+    )
+    failed |= not tsan_report.ok
+
     print(json.dumps({"metric": "lint", "results": results,
                       "status": "failed" if failed else "ok"}))
     return 1 if failed else 0
