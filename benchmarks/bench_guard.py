@@ -154,6 +154,66 @@ def validate_chaos_row(row) -> list:
     return problems
 
 
+#: Required key -> type for the ``benchmarks/online_arrivals.py`` gateway
+#: row. Same contract as CHAOS_ROW_REQUIRED: the bench self-validates before
+#: printing, and recorded rows can be re-checked without re-running it.
+ONLINE_ROW_REQUIRED = {
+    "metric": str,
+    "n_jobs": int,
+    "accepted": int,
+    "shed": int,
+    "shed_rate": float,
+    "admission_p50_s": float,
+    "admission_p99_s": float,
+    "makespan_s": float,
+    "base_rate_hz": float,
+    "burst_rate_hz": float,
+    "gateway_window": int,
+    "seed": int,
+    "status": str,
+}
+
+
+def validate_online_row(row) -> list:
+    """Schema-check one online-arrivals gateway row; returns human-readable
+    problems (empty list = valid)."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in ONLINE_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "online_arrivals":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'online_arrivals'"
+        )
+    if (isinstance(row.get("accepted"), int)
+            and isinstance(row.get("shed"), int)
+            and isinstance(row.get("n_jobs"), int)
+            and row["accepted"] + row["shed"] != row["n_jobs"]):
+        problems.append("accepted + shed != n_jobs (lost arrivals)")
+    sr = row.get("shed_rate")
+    if isinstance(sr, (int, float)) and not isinstance(sr, bool):
+        if not 0.0 <= sr <= 1.0:
+            problems.append(f"shed_rate {sr} outside [0, 1]")
+    p50, p99 = row.get("admission_p50_s"), row.get("admission_p99_s")
+    if (isinstance(p50, (int, float)) and isinstance(p99, (int, float))
+            and not isinstance(p50, bool) and not isinstance(p99, bool)
+            and p99 < p50):
+        problems.append("admission_p99_s < admission_p50_s")
+    return problems
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (

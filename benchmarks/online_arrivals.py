@@ -1,21 +1,30 @@
-"""Online service under a Poisson arrival trace: admission latency + makespan.
+"""Online service under Poisson + diurnal-burst arrival traces.
 
-Submits a seeded Poisson stream of jobs (exponential inter-arrival times,
-mixed priorities) to a running ``SaturnService`` on the 8 virtual CPU
-devices, twice against the same persistent profile cache directory:
+Two experiments, toward ROADMAP item 2 ("service scale-out to real
+traffic"):
 
-- **cold**: empty cache — every arrival pays its profiling sweep (the fake
-  technique sleeps per trial to stand in for XLA compile time),
-- **warm**: same task fingerprints again — every arrival resolves from the
-  cache with zero trials, so admission latency collapses to the lookup.
+1. **Cache phases (in-process)** — a seeded Poisson stream of jobs to a
+   running ``SaturnService``, twice against the same persistent profile
+   cache: **cold** (every arrival pays its profiling sweep) vs **warm**
+   (cache lookup, zero trials). Emits the ``online_admission_latency`` row.
 
-Prints ONE JSON line like ``bench.py``:
+2. **Gateway phase (over the wire)** — hundreds of jobs driven through the
+   network gateway under a Poisson base rate modulated by diurnal bursts
+   (periodic windows at a multiplied rate, the arrival shape a serving
+   front door actually sees). The gateway's inflight window is deliberately
+   small, so bursts overrun it and the shed path is exercised for real.
+   Reports client-observed admission latency p50/p99 and the shed rate.
 
-    {"metric": "online_admission_latency", "cold_s": ..., "warm_s": ...,
-     "speedup": ..., "makespan_cold_s": ..., "makespan_warm_s": ...,
-     "warm_trials": 0, "n_jobs": ...}
+Prints one JSON line per experiment (the gateway row last — it is the
+headline); the gateway row self-validates against
+``bench_guard.ONLINE_ROW_REQUIRED`` before printing:
 
-Run: ``python benchmarks/online_arrivals.py``.
+    {"metric": "online_admission_latency", "cold_s": ..., "warm_s": ...}
+    {"metric": "online_arrivals", "n_jobs": ..., "admission_p50_s": ...,
+     "admission_p99_s": ..., "shed_rate": ..., "status": "ok", ...}
+
+Run: ``python benchmarks/online_arrivals.py`` (``--gateway-only`` skips the
+cache phases).
 """
 
 from __future__ import annotations
@@ -26,7 +35,6 @@ import random
 import shutil
 import sys
 import tempfile
-import threading
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
@@ -38,8 +46,16 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 from saturn_tpu import library as lib
 from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.strategy import Strategy
 from saturn_tpu.core.technique import BaseTechnique
-from saturn_tpu.service import SaturnService, ServiceClient
+from saturn_tpu.service import (
+    GatewayClient,
+    GatewayError,
+    GatewayServer,
+    SaturnService,
+    ServiceClient,
+)
+from saturn_tpu.service.gateway import protocol
 from saturn_tpu.utils.metrics import read_events
 
 N_JOBS = 6
@@ -47,6 +63,18 @@ ARRIVAL_RATE_HZ = 5.0     # mean inter-arrival 200 ms
 TRIAL_COST_S = 0.02       # stand-in for compile time per profiling trial
 PER_BATCH_S = 0.004
 SEED = 7
+
+# Gateway-phase traffic shape: a Poisson base rate with periodic diurnal
+# bursts (every cycle, a burst window arrives at burst_rate instead). The
+# inflight window is sized so bursts overrun it — shed behavior is the
+# point, not an accident.
+N_ONLINE = 200
+BASE_RATE_HZ = 12.0
+BURST_RATE_HZ = 80.0
+BURST_EVERY = 50          # every 50 arrivals, a burst window opens...
+BURST_LEN = 20            # ...for 20 arrivals
+GATEWAY_WINDOW = 12       # gateway max_inflight (solver size stays bounded)
+ONLINE_BATCHES = 2        # tiny jobs: the wire, not the mesh, is measured
 
 
 class FakeDev:
@@ -128,30 +156,132 @@ def run_phase(phase: str, cache_dir: str, topo: SliceTopology) -> dict:
             os.unlink(mpath)
 
 
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _online_provider(tech):
+    """Gateway task rebuild: payload -> pre-profiled task (strategies filled,
+    so admission is the wire + queue, not a profiling sweep)."""
+
+    def provide(payload):
+        t = FakeTask(payload["task"], family=0,
+                     total_batches=payload["remaining_batches"])
+        sizes = (payload.get("spec") or {}).get("sizes", [4, 8])
+        t.strategies = {
+            g: Strategy(tech, g, {}, PER_BATCH_S * t.total_batches,
+                        PER_BATCH_S)
+            for g in sizes
+        }
+        return t
+
+    return provide
+
+
+def run_gateway_phase(topo: SliceTopology) -> dict:
+    """Drive N_ONLINE jobs through the gateway under Poisson + bursts.
+
+    Clients run with ``max_attempts=1`` on purpose: a shed is *counted*, not
+    retried away — the row measures what the front door refused, and retry
+    loops would hide exactly the behavior under test.
+    """
+    tech = BenchTech()
+    svc = SaturnService(
+        topology=topo, interval=0.2, poll_s=0.02,
+        task_provider=_online_provider(tech), health_guardian=False,
+    ).start()
+    gw = GatewayServer(svc, max_inflight=GATEWAY_WINDOW)
+    gw.start()
+    rng = random.Random(SEED)
+    latencies, accepted, shed = [], [], 0
+    t0 = time.monotonic()
+    try:
+        with GatewayClient(*gw.address, session="bench-online",
+                           seed=SEED, timeout_s=30.0,
+                           max_attempts=1) as client:
+            for i in range(N_ONLINE):
+                in_burst = (i % BURST_EVERY) < BURST_LEN
+                rate = BURST_RATE_HZ if in_burst else BASE_RATE_HZ
+                time.sleep(rng.expovariate(rate))
+                t_submit = time.monotonic()
+                try:
+                    jid = client.submit(
+                        name=f"online-{i}", total_batches=ONLINE_BATCHES,
+                        priority=float(rng.randint(0, 2)),
+                        spec={"sizes": [4, 8]},
+                    )
+                except GatewayError as e:
+                    if e.code not in (protocol.GW_RETRY_AFTER,
+                                      protocol.GW_UNAVAILABLE):
+                        raise
+                    shed += 1
+                    continue
+                latencies.append(time.monotonic() - t_submit)
+                accepted.append(jid)
+            for jid in accepted:
+                out = client.wait(jid, timeout=300)
+                if out["state"] != "DONE":
+                    raise SystemExit(f"gateway bench job not DONE: {out}")
+        makespan = time.monotonic() - t0
+    finally:
+        gw.shutdown(timeout=10, reason="bench-complete")
+        svc.stop(timeout=30)
+    latencies.sort()
+    return {
+        "metric": "online_arrivals",
+        "n_jobs": N_ONLINE,
+        "accepted": len(accepted),
+        "shed": shed,
+        "shed_rate": round(shed / N_ONLINE, 4),
+        "admission_p50_s": round(_percentile(latencies, 0.50), 6),
+        "admission_p99_s": round(_percentile(latencies, 0.99), 6),
+        "makespan_s": round(makespan, 3),
+        "base_rate_hz": BASE_RATE_HZ,
+        "burst_rate_hz": BURST_RATE_HZ,
+        "gateway_window": GATEWAY_WINDOW,
+        "seed": SEED,
+        "status": "ok",
+    }
+
+
 def main() -> None:
+    gateway_only = "--gateway-only" in sys.argv[1:]
     lib.register("bench-online", BenchTech)
     topo = SliceTopology([FakeDev() for _ in range(8)])
-    cache_dir = tempfile.mkdtemp(prefix="saturn_bench_pcache_")
-    try:
-        cold = run_phase("cold", cache_dir, topo)
-        warm = run_phase("warm", cache_dir, topo)
-    finally:
-        shutil.rmtree(cache_dir, ignore_errors=True)
 
-    print(json.dumps({
-        "metric": "online_admission_latency",
-        "cold_s": round(cold["mean_admission_s"], 6),
-        "warm_s": round(warm["mean_admission_s"], 6),
-        "speedup": round(
-            cold["mean_admission_s"] / max(warm["mean_admission_s"], 1e-9), 2
-        ),
-        "cold_trials": cold["trials"],
-        "warm_trials": warm["trials"],
-        "makespan_cold_s": round(cold["makespan_s"], 6),
-        "makespan_warm_s": round(warm["makespan_s"], 6),
-        "n_jobs": N_JOBS,
-        "unit": "s",
-    }))
+    if not gateway_only:
+        cache_dir = tempfile.mkdtemp(prefix="saturn_bench_pcache_")
+        try:
+            cold = run_phase("cold", cache_dir, topo)
+            warm = run_phase("warm", cache_dir, topo)
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+        print(json.dumps({
+            "metric": "online_admission_latency",
+            "cold_s": round(cold["mean_admission_s"], 6),
+            "warm_s": round(warm["mean_admission_s"], 6),
+            "speedup": round(
+                cold["mean_admission_s"] / max(warm["mean_admission_s"], 1e-9),
+                2,
+            ),
+            "cold_trials": cold["trials"],
+            "warm_trials": warm["trials"],
+            "makespan_cold_s": round(cold["makespan_s"], 6),
+            "makespan_warm_s": round(warm["makespan_s"], 6),
+            "n_jobs": N_JOBS,
+            "unit": "s",
+        }))
+
+    row = run_gateway_phase(topo)
+    import bench_guard
+    problems = bench_guard.validate_online_row(row)
+    if problems:
+        raise SystemExit(f"online row failed self-validation: {problems}")
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

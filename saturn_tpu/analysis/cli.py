@@ -14,6 +14,10 @@ Subcommands:
   would restore.  ``--unquarantine TASK[:i,j,k]`` appends a durable
   ``health_unquarantine`` record (all indices when no list is given) —
   the operator-facing undo for a batch range the guardian skip-listed.
+- ``gateway DIR``: operator view of a durability journal's gateway records
+  — the durable dedup table (idempotent submission keys -> job ids), retry
+  collapses, the shed ledger by reason, and drain markers (a missing or
+  dirty marker means the last incarnation died instead of handing off).
 - ``concurrency [PATH ...]``: saturn-tsan's static pass over the thread
   mesh — lock-order inversions, unguarded shared state, blocking calls
   under a lock, condition-wait-without-loop (SAT-C001..C004).  With no
@@ -161,6 +165,78 @@ def _cmd_health(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_gateway(args: argparse.Namespace) -> int:
+    from saturn_tpu.durability import journal as jmod
+
+    try:
+        records = list(jmod.replay(args.path))
+    except OSError as e:
+        print(f"cannot replay journal at {args.path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    submitted = 0
+    dedup: dict = {}          # key -> job id (the durable idempotency table)
+    hits: dict = {}           # key -> retry-collapse count
+    sheds: dict = {}          # reason -> count
+    drains: list = []
+    for rec in records:
+        kind, d = rec["kind"], rec.get("data", {})
+        if kind == "job_submitted":
+            submitted += 1
+            if d.get("dedup_key") is not None:
+                dedup[d["dedup_key"]] = d.get("job")
+        elif kind == "gateway_dedup_hit":
+            hits[d.get("key")] = hits.get(d.get("key"), 0) + 1
+        elif kind == "gateway_shed":
+            reason = d.get("reason", "unknown")
+            sheds[reason] = sheds.get(reason, 0) + 1
+        elif kind == "gateway_drain":
+            drains.append({
+                "reason": d.get("reason"),
+                "clean": d.get("clean"),
+                "sessions": d.get("sessions"),
+                "dedup_entries": d.get("dedup_entries"),
+                "dedup_hits": d.get("dedup_hits"),
+                "sheds": d.get("sheds"),
+            })
+    payload = {
+        "submitted": submitted,
+        "dedup_entries": len(dedup),
+        "dedup_hits": sum(hits.values()),
+        "dedup_hit_keys": {k: n for k, n in sorted(hits.items())},
+        "sheds": sheds,
+        "shed_total": sum(sheds.values()),
+        "drains": drains,
+        "last_drain_clean": drains[-1]["clean"] if drains else None,
+    }
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+        return 0
+    if not (submitted or sheds or drains or hits):
+        print(f"{args.path}: no gateway records in the durable journal")
+        return 0
+    print(f"{args.path}: {submitted} job(s) submitted, "
+          f"{len(dedup)} with a dedup key")
+    if hits:
+        print(f"idempotent retries collapsed: {sum(hits.values())} "
+              f"across {len(hits)} key(s)")
+        for key, n in sorted(hits.items()):
+            print(f"  {key} -> {dedup.get(key, '?')} (x{n})")
+    if sheds:
+        print("sheds: " + ", ".join(
+            f"{r}x{n}" for r, n in sorted(sheds.items())))
+    for dr in drains:
+        state = "clean" if dr["clean"] else "DIRTY"
+        print(f"drain ({dr['reason']}): {state}, "
+              f"{dr['sessions']} session(s), "
+              f"{dr['dedup_entries']} dedup entry(s), "
+              f"{dr['dedup_hits']} hit(s)")
+    if not drains:
+        print("no drain marker: the last gateway incarnation did not "
+              "hand off cleanly (crashed or still running)")
+    return 0
+
+
 def _cmd_concurrency(args: argparse.Namespace) -> int:
     from saturn_tpu.analysis.concurrency import static_pass
 
@@ -229,6 +305,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="append a durable un-quarantine record for TASK "
                         "(all its indices, or just i,j,k)")
     h.set_defaults(fn=_cmd_health)
+
+    g = sub.add_parser(
+        "gateway",
+        help="summarize journaled gateway records: dedup table, idempotent "
+             "retry hits, shed ledger, drain markers",
+    )
+    g.add_argument("path")
+    g.set_defaults(fn=_cmd_gateway)
 
     c = sub.add_parser(
         "concurrency",

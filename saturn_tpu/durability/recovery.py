@@ -48,6 +48,7 @@ class JobReplay:
     requeues: int = 0
     error: Optional[str] = None
     spec: Optional[dict] = None    # caller-supplied rebuild spec
+    dedup_key: Optional[str] = None  # gateway idempotency key (if any)
 
     @property
     def terminal(self) -> bool:
@@ -72,6 +73,13 @@ class ServiceRecovery:
     # and co-schedule detachments, replayed from health_* records.
     quarantined: Dict[str, List[int]] = field(default_factory=dict)
     detached: List[str] = field(default_factory=list)
+    #: Gateway idempotency table: dedup_key -> job_id, folded from
+    #: ``job_submitted`` records (the key rides the submission record, so a
+    #: key and its admission are durable atomically). The gateway seeds its
+    #: in-memory dedup map from this on restart — a client retrying a
+    #: submit whose ACK died with the previous incarnation gets the
+    #: original job id back, exactly-once across restarts.
+    dedup: Dict[str, str] = field(default_factory=dict)
 
     def live_jobs(self) -> List[JobReplay]:
         return [j for j in self.jobs.values() if not j.terminal]
@@ -157,7 +165,10 @@ def replay_service_state(root: str) -> ServiceRecovery:
                 max_retries=int(d.get("max_retries", 1)),
                 total_batches=int(d.get("total_batches") or 0),
                 spec=d.get("spec"),
+                dedup_key=d.get("dedup_key"),
             )
+            if d.get("dedup_key") is not None:
+                state.dedup[d["dedup_key"]] = d["job"]
         elif kind == "job_recovered":
             j = state.jobs.get(d["job"])
             if j is not None:
@@ -308,6 +319,7 @@ def build_restore_records(
                 task=RecoveredTaskStub(j.task, j.total_batches),
                 priority=j.priority, deadline_s=j.deadline_s,
                 max_retries=j.max_retries, spec=j.spec,
+                dedup_key=j.dedup_key,
             )
             rec = JobRecord(
                 job_id=j.job_id, request=req,
@@ -339,6 +351,7 @@ def build_restore_records(
         req = JobRequest(
             task=task, priority=j.priority, deadline_s=j.deadline_s,
             max_retries=j.max_retries, spec=j.spec,
+            dedup_key=j.dedup_key,
         )
         rec = JobRecord(
             job_id=j.job_id, request=req, state=JobState.QUEUED,

@@ -44,6 +44,13 @@ from saturn_tpu.resilience.faults import (
     seeded_schedule,
 )
 from saturn_tpu.resilience.health import DeviceHealth, FleetHealthMonitor, TopologyChange
+from saturn_tpu.resilience.netchaos import (
+    NET_FAULT_CLASSES,
+    NetChaosProxy,
+    NetChaosSpec,
+    NetChaosStats,
+    single_fault_spec,
+)
 from saturn_tpu.resilience.replan import RECOVERY_POLICIES, ElasticReplanner
 
 __all__ = [
@@ -67,4 +74,9 @@ __all__ = [
     "campaign_schedule",
     "run_campaign",
     "compare_checkpoints",
+    "NET_FAULT_CLASSES",
+    "NetChaosProxy",
+    "NetChaosSpec",
+    "NetChaosStats",
+    "single_fault_spec",
 ]

@@ -1,0 +1,334 @@
+"""Wire-level chaos: a seeded, frame-aware TCP proxy for the gateway.
+
+``chaos.py`` attacks the *training* plane (numeric faults, kills at journal
+barriers); this module attacks the *wire* between a
+:class:`~saturn_tpu.service.gateway.client.GatewayClient` and its
+:class:`~saturn_tpu.service.gateway.server.GatewayServer`. The proxy sits on
+its own port, pumps bytes both ways, reassembles them into JSONL frames, and
+injects faults per frame from a seeded RNG — same seed, same connection
+order, same fault sequence, every run (the chaos-without-flakes discipline
+of ``CampaignSpec``).
+
+Fault classes (:data:`NET_FAULT_CLASSES`):
+
+- ``drop``        — cut the connection before the frame is forwarded (the
+  client's request or response simply vanishes mid-flight);
+- ``delay``       — hold the frame ``delay_s`` before forwarding (stalls
+  that race the client's timeout);
+- ``partial``     — forward a strict byte prefix of the frame, then cut the
+  connection (a torn write: the peer reads garbage-then-EOF);
+- ``dup``         — forward the frame twice (the client must discard the
+  stray by ``rid``; a duplicated *request* must not double-admit);
+- ``reorder``     — hold the frame until after its successor (responses
+  arrive out of order; ``rid`` correlation must still match them);
+- ``kill_ack``    — server→client only: swallow the response and cut the
+  connection. For a submit this is the canonical lost-ACK window — the job
+  IS admitted and journaled, the client never hears; only the dedup key
+  makes the retry safe.
+
+What a netchaos campaign proves (``tests/test_gateway.py``): across seeds ×
+fault classes, **zero lost jobs** (every submitted job completes), **zero
+duplicate admissions** (retries never admit a second job for the same dedup
+key), and the surviving jobs' trajectories match an in-process run of the
+same mix.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("saturn_tpu")
+
+#: Every wire-fault class the proxy can inject (campaigns sweep these).
+NET_FAULT_CLASSES: Tuple[str, ...] = (
+    "drop", "delay", "partial", "dup", "reorder", "kill_ack",
+)
+
+#: Directions a fault can apply to. ``kill_ack`` is response-only by
+#: construction — killing a request is just ``drop``.
+_C2S = "c2s"
+_S2C = "s2c"
+
+
+@dataclass(frozen=True)
+class NetChaosSpec:
+    """One seeded wire-chaos configuration.
+
+    ``fault_rate`` is the per-frame probability of drawing a fault;
+    ``max_faults_per_conn`` caps how many times one connection can be hit so
+    a campaign always makes forward progress (the client's retry budget is
+    finite). ``skip_frames`` lets the first N frames of every connection
+    pass clean — the hello/session-resume exchange stays intact so faults
+    land on real requests, where the invariants actually bite.
+    """
+
+    seed: int
+    fault_classes: Tuple[str, ...] = NET_FAULT_CLASSES
+    fault_rate: float = 0.25
+    delay_s: float = 0.05
+    max_faults_per_conn: int = 2
+    skip_frames: int = 2
+
+
+@dataclass
+class NetChaosStats:
+    """What the proxy actually did — campaign asserts read these.
+    Counter updates come from every pump thread; all go through the lock."""
+
+    connections: int = 0
+    frames: int = 0
+    injected: Dict[str, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def note(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def note_frame(self) -> None:
+        with self._lock:
+            self.frames += 1
+
+    def total_injected(self) -> int:
+        with self._lock:
+            return sum(self.injected.values())
+
+
+class _Pump:
+    """One direction of one proxied connection: reassemble frames, consult
+    the seeded RNG per frame, forward (or maul) accordingly."""
+
+    def __init__(self, proxy: "NetChaosProxy", conn_id: int, direction: str,
+                 src: socket.socket, dst: socket.socket):
+        self.proxy = proxy
+        self.direction = direction
+        self.src = src
+        self.dst = dst
+        spec = proxy.spec
+        # Deterministic per (seed, connection ordinal, direction): the fault
+        # sequence depends only on the spec and the connection's arrival
+        # order, never on wall-clock or thread interleaving.
+        self.rng = random.Random(f"{spec.seed}:{conn_id}:{direction}")
+        self.faults_left = spec.max_faults_per_conn
+        self.skip = spec.skip_frames
+        self.held: Optional[bytes] = None   # a reorder-held frame
+        self.classes = [
+            c for c in spec.fault_classes
+            if c != "kill_ack" or direction == _S2C
+        ]
+
+    def run(self) -> None:
+        reader = self.src.makefile("rb")
+        try:
+            while True:
+                try:
+                    frame = reader.readline()
+                except OSError:
+                    break
+                if not frame:
+                    break
+                if not self._forward(frame):
+                    break
+            self._flush_held()
+        finally:
+            try:
+                reader.close()
+            except OSError:
+                pass
+            # Propagate EOF so the peer's reader unblocks; the other pump
+            # dies on its own EOF/ECONNRESET.
+            for s in (self.src, self.dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------ forwarding
+    def _forward(self, frame: bytes) -> bool:
+        """Forward one frame, possibly injecting a fault. Returns False when
+        the connection was cut (by a fault or a dead peer)."""
+        self.proxy.stats.note_frame()
+        fault = self._draw()
+        if fault is None:
+            return self._send(frame)
+        spec = self.proxy.spec
+        self.proxy.stats.note(fault)
+        logger.info("netchaos: inject %s on %s frame", fault, self.direction)
+        if fault == "drop":
+            return False
+        if fault == "kill_ack":
+            # The response vanishes AND the transport dies: the client's
+            # view is indistinguishable from a server crash mid-ACK.
+            return False
+        if fault == "delay":
+            time.sleep(spec.delay_s)
+            return self._send(frame)
+        if fault == "partial":
+            cut = max(1, self.rng.randrange(1, max(2, len(frame))))
+            try:
+                self.dst.sendall(frame[:cut])
+            except OSError:
+                pass
+            return False
+        if fault == "dup":
+            return self._send(frame) and self._send(frame)
+        if fault == "reorder":
+            if self.held is None:
+                self.held = frame   # hold; released after the next frame
+                return True
+            return self._send(frame)  # _send flushes the held frame second
+        raise AssertionError(f"unknown fault class {fault!r}")
+
+    def _draw(self) -> Optional[str]:
+        if self.skip > 0:
+            self.skip -= 1
+            return None
+        if self.faults_left <= 0 or not self.classes:
+            return None
+        if self.rng.random() >= self.proxy.spec.fault_rate:
+            return None
+        self.faults_left -= 1
+        return self.rng.choice(self.classes)
+
+    def _send(self, frame: bytes) -> bool:
+        held, self.held = self.held, None
+        try:
+            if held is not None:
+                # A reorder hold with no successor on the wire must not rot:
+                # anything newer flushes it first-in-second.
+                self.dst.sendall(frame + held)
+            else:
+                self.dst.sendall(frame)
+        except OSError:
+            return False
+        return True
+
+    def _flush_held(self) -> None:
+        if self.held is not None:
+            held, self.held = self.held, None
+            try:
+                self.dst.sendall(held)
+            except OSError:
+                pass
+
+
+class NetChaosProxy:
+    """Seeded chaos TCP proxy: listen on :attr:`address`, forward to
+    ``(upstream_host, upstream_port)``, maul frames per ``spec``.
+
+    Use as a context manager or call :meth:`start` / :meth:`stop`. Point a
+    ``GatewayClient`` at ``proxy.address`` instead of the gateway's.
+    """
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 spec: NetChaosSpec, host: str = "127.0.0.1", port: int = 0):
+        self.upstream = (upstream_host, upstream_port)
+        self.spec = spec
+        self.host = host
+        self.port = port
+        self.stats = NetChaosStats()
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
+        self._socks: List[socket.socket] = []
+        self.address: Tuple[str, int] = (host, port)
+
+    def start(self) -> "NetChaosProxy":
+        sock = socket.create_server((self.host, self.port))
+        sock.settimeout(0.2)
+        self._listener = sock
+        self.address = sock.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="netchaos-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            socks = list(self._socks)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(5.0)
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in list(self._threads):
+            t.join(5.0)
+
+    def __enter__(self) -> "NetChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    break
+            try:
+                client, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                server = socket.create_connection(self.upstream, timeout=5.0)
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            with self._lock:
+                if self._stopped:
+                    for s in (client, server):
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                    break
+                conn_id = self.stats.connections
+                self.stats.connections += 1
+                self._socks += [client, server]
+                for direction, src, dst in (
+                    (_C2S, client, server), (_S2C, server, client),
+                ):
+                    pump = _Pump(self, conn_id, direction, src, dst)
+                    t = threading.Thread(
+                        target=pump.run,
+                        name=f"netchaos-{conn_id}-{direction}", daemon=True,
+                    )
+                    self._threads.append(t)
+                    t.start()
+
+
+def single_fault_spec(seed: int, fault_class: str,
+                      **overrides) -> NetChaosSpec:
+    """A spec that injects exactly one fault class — the campaign's
+    seeds × classes sweep builds its grid from these."""
+    if fault_class not in NET_FAULT_CLASSES:
+        raise ValueError(
+            f"{fault_class!r} is not a wire-fault class "
+            f"(use one of {NET_FAULT_CLASSES})"
+        )
+    defaults = dict(fault_rate=0.5, max_faults_per_conn=1)
+    defaults.update(overrides)
+    return NetChaosSpec(
+        seed=seed, fault_classes=(fault_class,), **defaults
+    )

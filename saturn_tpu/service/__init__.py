@@ -18,6 +18,10 @@ that accepts work over time:
 - :mod:`saturn_tpu.service.client` — in-process client
   (``submit / status / wait / cancel``) and the ``python -m
   saturn_tpu.service`` CLI that tails the JSONL metrics stream.
+- :mod:`saturn_tpu.service.gateway` — JSONL-over-TCP network front door:
+  :class:`GatewayServer` (idempotent submission, per-request deadlines,
+  backpressure windows, graceful drain) and the retrying
+  :class:`GatewayClient` with the same client surface.
 
 See ``docs/architecture.md`` ("Online service") for the state machine and
 the divergence notes in ``docs/parity.md``.
@@ -25,6 +29,7 @@ the divergence notes in ``docs/parity.md``.
 
 from saturn_tpu.service.admission import AdmissionController, AdmissionDecision
 from saturn_tpu.service.client import ServiceClient
+from saturn_tpu.service.gateway import GatewayClient, GatewayError, GatewayServer
 from saturn_tpu.service.queue import (
     JobRecord,
     JobRequest,
@@ -36,6 +41,9 @@ from saturn_tpu.service.server import SaturnService
 __all__ = [
     "AdmissionController",
     "AdmissionDecision",
+    "GatewayClient",
+    "GatewayError",
+    "GatewayServer",
     "JobRecord",
     "JobRequest",
     "JobState",

@@ -1,0 +1,30 @@
+"""Fault-tolerant network gateway: JSONL-over-TCP transport for the service.
+
+- :mod:`protocol` — frame schema, typed wire errors, exception mapping
+- :mod:`server` — :class:`GatewayServer`: threaded front door with
+  idempotent submission, per-request deadlines, backpressure windows, and
+  graceful drain
+- :mod:`client` — :class:`GatewayClient`: retrying client with the
+  ``ServiceClient`` surface
+
+Chaos-tested by ``resilience/netchaos.py`` (wire faults) plus the crash
+harness (gateway kills); see ``tests/test_gateway.py``.
+"""
+
+from saturn_tpu.service.gateway.client import GatewayClient
+from saturn_tpu.service.gateway.protocol import (
+    ERROR_CODES,
+    GatewayError,
+    RETRIABLE_CODES,
+    classify_exception,
+)
+from saturn_tpu.service.gateway.server import GatewayServer
+
+__all__ = [
+    "ERROR_CODES",
+    "GatewayClient",
+    "GatewayError",
+    "GatewayServer",
+    "RETRIABLE_CODES",
+    "classify_exception",
+]

@@ -1,0 +1,271 @@
+"""Retrying gateway client with the :class:`ServiceClient` surface.
+
+``GatewayClient`` speaks the JSONL frame protocol to a
+:class:`~saturn_tpu.service.gateway.server.GatewayServer` and hides the
+hostile wire from the caller:
+
+- **Timeouts + capped exponential backoff with deterministic jitter.**
+  Every transport failure or retriable server verdict (``GW_RETRY_AFTER``,
+  ``GW_DRAINING``, ``GW_UNAVAILABLE``) sleeps ``min(cap, base·2^attempt)``
+  plus a jitter drawn from a seeded ``random.Random`` — two clients built
+  with the same seed replay the same retry schedule, so chaos campaigns are
+  reproducible run-to-run.
+- **Reconnect with session resume.** The client owns a stable ``session``
+  id; after a reconnect it re-sends ``hello`` and the gateway re-associates
+  the session's live jobs (the per-session inflight window survives the
+  TCP connection dying).
+- **Idempotent submits.** Each ``submit`` mints one ``dedup_key`` *before*
+  the first attempt and reuses it across every retry — if the first
+  attempt's ACK died on the wire (or the gateway died mid-ACK), the retry
+  lands on the journaled dedup entry and returns the original job id.
+- **rid correlation.** Responses are matched by echoed ``rid``; stray
+  frames (a chaos proxy duplicating or reordering lines) are discarded,
+  never mistaken for the answer to the current request.
+
+The surface mirrors ``ServiceClient`` (submit/status/wait/cancel) so
+in-process callers swap to the wire transparently; ``submit`` additionally
+accepts plain keyword job fields for callers with no task object in hand.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time
+from typing import Any, Dict, Optional
+
+from saturn_tpu.service.gateway import protocol
+from saturn_tpu.service.gateway.protocol import GatewayError
+
+_TERMINAL_STATES = ("DONE", "FAILED", "EVICTED")
+
+
+class GatewayClient:
+    """submit / status / wait / cancel against a gateway over TCP."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        session: Optional[str] = None,
+        seed: int = 0,
+        timeout_s: float = 10.0,
+        max_attempts: int = 8,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+    ):
+        self.host = host
+        self.port = port
+        self.session = session or f"gwc-{seed}-{id(self) & 0xFFFF:04x}"
+        self.timeout_s = timeout_s
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._rng = random.Random(seed)  # deterministic jitter + dedup keys
+        self._rid = 0
+        self._dedup_seq = 0
+        self._sock: Optional[socket.socket] = None
+        self._reader = None
+        self.reconnects = 0
+        self.retries = 0
+
+    # ------------------------------------------------------------- transport
+    def _connect(self) -> None:
+        self.close()
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        )
+        self._sock = sock
+        self._reader = sock.makefile("rb")
+        # Session resume: re-associate this client's live jobs with the
+        # (possibly restarted) gateway before any real request runs.
+        rid = self._next_rid()
+        self._write({"op": "hello", "rid": rid, "session": self.session})
+        self._read_response(rid)
+        self.reconnects += 1
+
+    def close(self) -> None:
+        if self._reader is not None:
+            try:
+                self._reader.close()
+            except OSError:
+                pass
+            self._reader = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def __enter__(self) -> "GatewayClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _next_rid(self) -> str:
+        self._rid += 1
+        return f"{self.session}:r{self._rid}"
+
+    def _write(self, frame: Dict[str, Any]) -> None:
+        self._sock.sendall(protocol.encode_frame(frame))
+
+    def _read_response(self, rid: str) -> Dict[str, Any]:
+        """Read frames until the one answering ``rid`` arrives.
+
+        A hostile wire may duplicate or reorder frames; anything whose rid
+        is not ours is a stray (an old duplicate, a reordered earlier
+        response) and is dropped on the floor — correctness never depends
+        on arrival order.
+        """
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout(f"no response to {rid}")
+            self._sock.settimeout(remaining)
+            line = self._reader.readline(protocol.MAX_FRAME_BYTES + 1)
+            if not line:
+                raise ConnectionError("gateway closed the connection")
+            try:
+                frame = protocol.decode_frame(line)
+            except GatewayError:
+                continue  # torn/garbled stray — keep scanning for ours
+            if frame.get("rid") != rid:
+                continue
+            if frame.get("ok"):
+                result = frame.get("result")
+                return result if isinstance(result, dict) else {}
+            raise GatewayError.from_wire(frame.get("error"))
+
+    def _backoff(self, attempt: int, hint: Optional[float]) -> float:
+        base = min(self.backoff_cap_s,
+                   self.backoff_base_s * (2.0 ** attempt))
+        if hint is not None:
+            base = max(base, float(hint))
+        # Deterministic jitter: same seed → same schedule, but two clients
+        # with different seeds desynchronize instead of thundering together.
+        return base * (0.5 + self._rng.random())
+
+    def _call(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """One request with reconnect + retry. The frame is identical on
+        every attempt (same dedup_key, fresh rid), so retries are safe."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                if self._sock is None:
+                    self._connect()
+                rid = self._next_rid()
+                self._write(dict(frame, rid=rid, session=self.session))
+                return self._read_response(rid)
+            except GatewayError as e:
+                if not e.retriable:
+                    raise
+                last = e
+                hint = e.retry_after_s
+            except (OSError, ConnectionError) as e:
+                # Transport died mid-request: drop the connection; the next
+                # attempt reconnects and resumes the session.
+                self.close()
+                last = e
+                hint = None
+            self.retries += 1
+            time.sleep(self._backoff(attempt, hint))
+        raise GatewayError(
+            protocol.GW_UNAVAILABLE,
+            f"gateway unreachable after {self.max_attempts} attempts: "
+            f"{type(last).__name__}: {last}",
+        )
+
+    # -------------------------------------------------------------- surface
+    def submit(self, task=None, priority: float = 0.0,
+               deadline_s: Optional[float] = None,
+               max_retries: int = 1,
+               spec: Optional[dict] = None,
+               *,
+               name: Optional[str] = None,
+               total_batches: Optional[int] = None,
+               request_deadline_s: Optional[float] = None,
+               dedup_key: Optional[str] = None) -> str:
+        """Enqueue a job; returns the job id (the original id on a retry).
+
+        Accepts either a task object (its ``name``/``total_batches`` cross
+        the wire; the server's ``task_provider`` rebuilds the object) or
+        explicit ``name=``/``total_batches=`` keywords. ``deadline_s`` is
+        the *job's* completion deadline (the pressure shedder's input);
+        ``request_deadline_s`` bounds only this submission's time-in-gateway
+        before admission.
+        """
+        if task is not None:
+            name = getattr(task, "name", None)
+            if total_batches is None:
+                total_batches = getattr(task, "total_batches", None)
+        if not name:
+            raise GatewayError(protocol.GW_BADREQUEST,
+                               "submit needs a task or a name=")
+        if dedup_key is None:
+            # Unique per logical submit, even across two client instances
+            # resuming the same session: the counter alone would collide
+            # (both start at d1), so a seeded-random component disambiguates
+            # — deterministic per (seed, submit ordinal), never shared.
+            self._dedup_seq += 1
+            dedup_key = (f"{self.session}:d{self._dedup_seq}"
+                         f"-{self._rng.randrange(1 << 30):08x}")
+        frame: Dict[str, Any] = {
+            "op": "submit",
+            "dedup_key": dedup_key,
+            "job": {
+                "name": name,
+                "total_batches": int(total_batches or 0),
+                "priority": priority,
+                "deadline_s": deadline_s,
+                "max_retries": max_retries,
+                "spec": spec,
+            },
+        }
+        if request_deadline_s is not None:
+            frame["deadline_s"] = request_deadline_s
+        return str(self._call(frame)["job_id"])
+
+    def status(self, job_id: str) -> dict:
+        """Point-in-time snapshot of the job's lifecycle record."""
+        return self._call({"op": "status", "job": job_id})
+
+    def wait(self, job_id: str, timeout: Optional[float] = None) -> dict:
+        """Block until the job is DONE/FAILED/EVICTED; raises
+        ``TimeoutError`` otherwise. Long waits are chunked into bounded
+        server-side waits so a single TCP stall never wedges the caller
+        past its transport timeout."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        while True:
+            remaining = (
+                deadline - time.monotonic() if deadline is not None else None
+            )
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError(
+                    f"job {job_id} not terminal after {timeout}s"
+                )
+            # Ask the server to hold for well under our transport timeout —
+            # a chunk that races _read_response's deadline would turn every
+            # quiet wait into a spurious reconnect.
+            chunk = max(0.1, self.timeout_s * 0.5)
+            if remaining is not None:
+                chunk = min(chunk, remaining)
+            snap = self._call(
+                {"op": "wait", "job": job_id, "timeout_s": chunk}
+            )
+            if snap.get("terminal") or snap.get("state") in _TERMINAL_STATES:
+                snap.pop("terminal", None)
+                return snap
+
+    def cancel(self, job_id: str) -> bool:
+        """Request eviction; False if the job already reached a terminal
+        state."""
+        return bool(self._call({"op": "cancel", "job": job_id})["cancelled"])
+
+    def ping(self) -> dict:
+        return self._call({"op": "ping"})

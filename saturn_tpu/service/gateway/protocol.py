@@ -1,0 +1,186 @@
+"""Wire protocol for the JSONL-over-TCP gateway: frames + typed errors.
+
+One frame per line, UTF-8 JSON, newline-terminated — the same
+line-per-record discipline as the metrics stream and the write-ahead
+journal, so every transport artifact in the system tails with the same
+tools. Requests carry::
+
+    {"op": "submit", "rid": "<session>:<n>", "session": "<client id>",
+     "deadline_s": 5.0, "dedup_key": "<session>:d<n>",
+     "job": {"name": ..., "total_batches": ..., "priority": ...,
+             "deadline_s": ..., "max_retries": ..., "spec": {...}}}
+
+and responses::
+
+    {"rid": "<echoed>", "ok": true,  "result": {...}}
+    {"rid": "<echoed>", "ok": false, "error": {"code": "GW_RETRY_AFTER",
+     "message": ..., "retriable": true, "retry_after_s": 0.5}}
+
+``rid`` is the client's request correlator: a hostile wire may duplicate or
+reorder frames (see ``resilience/netchaos.py``), so the client matches
+responses by ``rid`` and discards strays instead of trusting arrival order.
+
+Error codes are **typed and closed** (:data:`ERROR_CODES`): every failure
+the server can hand a client serializes as a code the client can branch on,
+never a raw exception string — :func:`classify_exception` is the single
+mapping from in-process exceptions (queue duplicate-name rejection, unknown
+job ids) to wire errors, and :class:`GatewayError` round-trips through
+``to_wire``/``from_wire`` losslessly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+#: Hard per-frame byte cap (including the newline). A frame this size is a
+#: protocol violation, not a big request — submit specs are small JSON.
+MAX_FRAME_BYTES = 256 * 1024
+
+#: Protocol revision, echoed in the hello exchange.
+PROTO_VERSION = 1
+
+# --------------------------------------------------------------- error codes
+GW_BADFRAME = "GW_BADFRAME"                  # unparseable / oversized frame
+GW_BADREQUEST = "GW_BADREQUEST"              # missing/invalid fields, bad op
+GW_DUPLICATE_NAME = "GW_DUPLICATE_NAME"      # task name already live (queue)
+GW_DEADLINE_EXPIRED = "GW_DEADLINE_EXPIRED"  # request deadline passed pre-admission
+GW_RETRY_AFTER = "GW_RETRY_AFTER"            # backpressure: inflight window full
+GW_DRAINING = "GW_DRAINING"                  # gateway draining, not accepting
+GW_UNKNOWN_JOB = "GW_UNKNOWN_JOB"            # status/wait/cancel on unknown id
+GW_INTERNAL = "GW_INTERNAL"                  # unexpected server-side exception
+GW_UNAVAILABLE = "GW_UNAVAILABLE"            # client-side: transport exhausted
+
+ERROR_CODES = frozenset({
+    GW_BADFRAME,
+    GW_BADREQUEST,
+    GW_DUPLICATE_NAME,
+    GW_DEADLINE_EXPIRED,
+    GW_RETRY_AFTER,
+    GW_DRAINING,
+    GW_UNKNOWN_JOB,
+    GW_INTERNAL,
+    GW_UNAVAILABLE,
+})
+
+#: Codes a client may transparently retry (with backoff / after
+#: ``retry_after_s``). Everything else is a terminal verdict for the call.
+RETRIABLE_CODES = frozenset({GW_RETRY_AFTER, GW_DRAINING, GW_UNAVAILABLE})
+
+
+class GatewayError(Exception):
+    """A typed, wire-serializable gateway failure.
+
+    ``retriable`` defaults from the code's class; ``retry_after_s`` is the
+    server's backpressure hint (only meaningful with ``GW_RETRY_AFTER``).
+    """
+
+    def __init__(self, code: str, message: str = "", *,
+                 retriable: Optional[bool] = None,
+                 retry_after_s: Optional[float] = None):
+        if code not in ERROR_CODES:
+            raise ValueError(f"unknown gateway error code {code!r}")
+        super().__init__(f"{code}: {message}" if message else code)
+        self.code = code
+        self.message = message
+        self.retriable = (
+            retriable if retriable is not None else code in RETRIABLE_CODES
+        )
+        self.retry_after_s = retry_after_s
+
+    def to_wire(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "code": self.code,
+            "message": self.message,
+            "retriable": self.retriable,
+        }
+        if self.retry_after_s is not None:
+            out["retry_after_s"] = round(float(self.retry_after_s), 6)
+        return out
+
+    @classmethod
+    def from_wire(cls, payload: Any) -> "GatewayError":
+        if not isinstance(payload, dict):
+            return cls(GW_INTERNAL, f"malformed error payload: {payload!r}")
+        code = payload.get("code")
+        if code not in ERROR_CODES:
+            return cls(
+                GW_INTERNAL,
+                f"unknown error code {code!r}: {payload.get('message', '')}",
+            )
+        return cls(
+            code,
+            str(payload.get("message", "")),
+            retriable=bool(payload.get("retriable", code in RETRIABLE_CODES)),
+            retry_after_s=payload.get("retry_after_s"),
+        )
+
+
+def classify_exception(exc: BaseException) -> GatewayError:
+    """Map an in-process service exception to its typed wire error.
+
+    The single choke point for the ServiceClient ↔ service error paths: the
+    queue's duplicate-live-name rejection and bad-request ``ValueError``s,
+    the registry's unknown-job ``KeyError``, and anything unexpected
+    (``GW_INTERNAL``, carrying the exception type so the operator can grep
+    the server log) — never a bare ``repr`` the client must string-match.
+    """
+    if isinstance(exc, GatewayError):
+        return exc
+    if isinstance(exc, KeyError):
+        return GatewayError(GW_UNKNOWN_JOB, str(exc.args[0]) if exc.args
+                            else "unknown job id")
+    if isinstance(exc, ValueError):
+        if "already live" in str(exc):
+            return GatewayError(GW_DUPLICATE_NAME, str(exc))
+        return GatewayError(GW_BADREQUEST, str(exc))
+    return GatewayError(
+        GW_INTERNAL, f"{type(exc).__name__}: {exc}"
+    )
+
+
+# ------------------------------------------------------------------- framing
+def encode_frame(obj: Dict[str, Any]) -> bytes:
+    """One compact JSON object + newline. Refuses frames over the cap —
+    better to fail the sender loudly than wedge the peer's readline."""
+    data = (json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                       default=str) + "\n").encode("utf-8")
+    if len(data) > MAX_FRAME_BYTES:
+        raise GatewayError(
+            GW_BADFRAME,
+            f"frame of {len(data)} bytes exceeds the {MAX_FRAME_BYTES}-byte "
+            "cap",
+        )
+    return data
+
+
+def decode_frame(line: bytes) -> Dict[str, Any]:
+    """Parse one received line into a frame dict, or raise ``GW_BADFRAME``.
+
+    A line at (or past) the byte cap without a terminating newline means the
+    peer is mid-way through an oversized frame — the connection is
+    unrecoverable from here (the rest of the frame would parse as garbage),
+    so the caller should respond and close.
+    """
+    if len(line) > MAX_FRAME_BYTES or (len(line) >= MAX_FRAME_BYTES
+                                       and not line.endswith(b"\n")):
+        raise GatewayError(
+            GW_BADFRAME, f"frame exceeds the {MAX_FRAME_BYTES}-byte cap"
+        )
+    try:
+        obj = json.loads(line.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError, ValueError) as e:
+        raise GatewayError(GW_BADFRAME, f"unparseable frame: {e}") from e
+    if not isinstance(obj, dict):
+        raise GatewayError(
+            GW_BADFRAME, f"frame is {type(obj).__name__}, expected object"
+        )
+    return obj
+
+
+def ok_response(rid: Any, result: Dict[str, Any]) -> Dict[str, Any]:
+    return {"rid": rid, "ok": True, "result": result}
+
+
+def error_response(rid: Any, err: GatewayError) -> Dict[str, Any]:
+    return {"rid": rid, "ok": False, "error": err.to_wire()}

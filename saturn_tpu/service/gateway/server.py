@@ -1,0 +1,622 @@
+"""Threaded JSONL-over-TCP gateway in front of :class:`SaturnService`.
+
+One accept thread plus one reader thread per connection, all feeding the
+service's existing :class:`~saturn_tpu.service.queue.SubmissionQueue` — the
+gateway owns the *wire* concerns the in-process client never had:
+
+- **Idempotent submission.** Every submit may carry a client-supplied
+  ``dedup_key``. The key rides the ``job_submitted`` journal record (the
+  queue observer writes it in the same durable group commit as the
+  submission itself), so a retried submit whose ACK was lost — to a dropped
+  connection, a chaos-proxy mid-ACK kill, or a gateway death — returns the
+  *original* job id, exactly-once across process incarnations
+  (``replay_service_state`` folds the dedup table back; the gateway seeds
+  its map from ``SaturnService.recovered_dedup``).
+- **Per-request deadlines.** Frames carry ``deadline_s`` (the client's
+  remaining budget at send time); expired work is shed *before* admission —
+  at dispatch, and again after waiting out the dedup lock — so a backlogged
+  gateway never burns profiling/solver time on a request whose client
+  already gave up.
+- **Bounded inflight windows + explicit backpressure.** A global cap on
+  live jobs and a per-session cap on a client's outstanding submissions;
+  past either, the submit is refused with ``GW_RETRY_AFTER`` and a
+  ``retry_after_s`` hint instead of silently queueing. The window is wired
+  to the service's deadline-pressure load shedder: while the shedder has
+  recently evicted (``SaturnService.last_pressure_shed``), the effective
+  global window shrinks by ``pressure_window_factor`` so the wire stops
+  feeding a mesh that is already shedding admitted work.
+- **Graceful drain.** ``shutdown()`` (or SIGTERM via
+  :meth:`install_sigterm`) stops accepting connections and submissions,
+  lets in-flight requests flush their responses, and journals a durable
+  ``gateway_drain`` handoff marker with the shed/dedup ledger.
+
+Locks are named into the saturn-tsan graph (``gateway.conns``,
+``gateway.dedup``) with the acquisition order ``gateway.dedup →
+gateway.conns → …`` and ``gateway.dedup → queue.lock → journal.lock``;
+nothing ever acquires a gateway lock while holding a queue or journal lock.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from saturn_tpu.analysis import concurrency as tsan
+from saturn_tpu.analysis.concurrency import sched_point
+from saturn_tpu.resilience.crash import SimulatedKill
+from saturn_tpu.service.gateway import protocol
+from saturn_tpu.service.gateway.protocol import GatewayError
+from saturn_tpu.service.queue import TERMINAL_STATES, JobRequest
+from saturn_tpu.utils import metrics
+
+logger = logging.getLogger("saturn_tpu")
+
+_ACCEPT_POLL_S = 0.2
+
+
+class _Session:
+    """Per-client state that survives reconnects (session resume): the set
+    of job ids this client submitted, for the per-session inflight window."""
+
+    def __init__(self, sid: str):
+        self.sid = sid
+        self.jobs: set = set()
+        self.connects = 0
+
+
+class _Conn:
+    def __init__(self, cid: int, sock: socket.socket, addr: Any,
+                 thread: threading.Thread):
+        self.cid = cid
+        self.sock = sock
+        self.addr = addr
+        self.thread = thread
+
+
+class GatewayServer:
+    """TCP front door for one :class:`SaturnService`.
+
+    The service must run with a ``task_provider`` — wire submissions carry
+    a JSON job payload, and the provider rebuilds the task object exactly
+    as crash recovery does (same payload contract as
+    ``build_restore_records``). ``port=0`` binds an ephemeral port; read
+    :attr:`address` after :meth:`start`.
+    """
+
+    def __init__(
+        self,
+        service,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        max_inflight: int = 64,
+        max_inflight_per_session: int = 16,
+        pressure_window_factor: float = 0.5,
+        pressure_cooldown_s: Optional[float] = None,
+        retry_after_s: Optional[float] = None,
+        wait_chunk_cap_s: float = 5.0,
+    ):
+        self.service = service
+        self.host = host
+        self.port = port
+        self.max_inflight = max_inflight
+        self.max_inflight_per_session = max_inflight_per_session
+        self.pressure_window_factor = pressure_window_factor
+        self.pressure_cooldown_s = (
+            pressure_cooldown_s if pressure_cooldown_s is not None
+            else 5.0 * getattr(service, "interval", 1.0)
+        )
+        self.retry_after_s = (
+            retry_after_s if retry_after_s is not None
+            else getattr(service, "interval", 1.0)
+        )
+        self.wait_chunk_cap_s = wait_chunk_cap_s
+
+        # gateway.conns guards the connection registry, sessions, drain flag
+        # and the shed ledger; gateway.dedup guards the dedup table AND
+        # serializes the submit path (check-key → queue.submit → record-key
+        # must be atomic so a concurrent retry of the same key can never
+        # double-submit). Order: gateway.dedup → gateway.conns, never the
+        # reverse.
+        self._lock = tsan.rlock("gateway.conns")
+        self._dedup_lock = tsan.rlock("gateway.dedup")
+        self._conns: Dict[int, _Conn] = {}
+        self._sessions: Dict[str, _Session] = {}
+        self._sheds: Dict[str, int] = {}
+        self._draining = False
+        self._next_conn = 0
+        # Exactly-once across restarts: seed the dedup table from the
+        # journal replay the service already performed.
+        self._dedup: Dict[str, str] = dict(
+            getattr(service, "recovered_dedup", None) or {}
+        )
+        self._dedup_hits = 0
+
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self.address: Tuple[str, int] = (host, port)
+        self.killed = False  # set only by the crash harness's SimulatedKill
+        # Set once shutdown() has fully completed (marker journaled). Hosts
+        # that drain from a signal handler's thread must wait on this before
+        # stopping the service, or the marker races the journal close.
+        self._drained = threading.Event()
+
+    # ---------------------------------------------------------------- control
+    def start(self) -> "GatewayServer":
+        if self._accept_thread is not None:
+            raise RuntimeError("gateway already started")
+        sock = socket.create_server((self.host, self.port))
+        sock.settimeout(_ACCEPT_POLL_S)  # poll-able accept → prompt drain
+        self._listener = sock
+        self.address = sock.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="gw-accept", daemon=True
+        )
+        self._accept_thread.start()
+        logger.info("gateway listening on %s:%d", *self.address)
+        return self
+
+    def install_sigterm(self) -> bool:
+        """Register a SIGTERM handler that drains this gateway. Returns False
+        when not callable (non-main thread / unsupported platform)."""
+        import signal
+
+        def _on_term(signum, frame):
+            threading.Thread(
+                target=self.shutdown, kwargs={"reason": "SIGTERM"},
+                name="gw-sigterm", daemon=True,
+            ).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError, AttributeError):
+            return False
+        return True
+
+    def shutdown(self, timeout: float = 10.0,
+                 reason: str = "shutdown") -> bool:
+        """Graceful drain: stop accepting, flush inflight responses, journal
+        a durable handoff marker. Returns True when every reader thread
+        exited inside ``timeout`` (a clean handoff)."""
+        sched_point("gateway.drain")
+        with self._lock:
+            already = self._draining
+            self._draining = True
+            conns = list(self._conns.values())
+        if already:
+            # First caller owns the drain; wait for it to finish so every
+            # returner sees the marker durably journaled.
+            self._drained.wait(timeout)
+            return True
+        listener = self._listener
+        if listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout)
+        # Half-close every connection's read side: no new requests arrive,
+        # the request a reader is mid-way through still writes its response
+        # (the write side stays open until the reader exits).
+        for c in conns:
+            try:
+                c.sock.shutdown(socket.SHUT_RD)
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout
+        clean = True
+        for c in conns:
+            c.thread.join(max(0.0, deadline - time.monotonic()))
+            if c.thread.is_alive():
+                clean = False
+        with self._lock:
+            sheds = dict(self._sheds)
+            sessions = len(self._sessions)
+        with self._dedup_lock:
+            dedup_entries = len(self._dedup)
+            dedup_hits = self._dedup_hits
+        jnl = self.service.journal
+        if jnl is not None:
+            # The durable clean-handoff marker: the analysis CLI and the
+            # next incarnation's operator can tell a drained gateway from a
+            # killed one.
+            jnl.log(
+                "gateway_drain", reason=reason, clean=clean,
+                sessions=sessions, dedup_entries=dedup_entries,
+                dedup_hits=dedup_hits, sheds=sheds,
+            )
+        metrics.event("gateway_drain", reason=reason, clean=clean,
+                      sessions=sessions, sheds=sheds)
+        if not clean:
+            logger.warning(
+                "gateway drain (%s): %d connection(s) still flushing past "
+                "%.1fs", reason, sum(c.thread.is_alive() for c in conns),
+                timeout,
+            )
+        self._drained.set()
+        return clean
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until a drain (e.g. the SIGTERM handler's) has fully
+        completed — marker journaled, readers joined. A host process must
+        call this before stopping the service: the handler drains on a
+        daemon thread, and exiting early kills it mid-handoff."""
+        return self._drained.wait(timeout)
+
+    # ----------------------------------------------------------- accept loop
+    def _accept_loop(self) -> None:
+        sched_point("gateway.accept")
+        listener = self._listener
+        while True:
+            with self._lock:
+                if self._draining:
+                    break
+            try:
+                sock, addr = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed by shutdown
+            self._register(sock, addr)
+
+    def _register(self, sock: socket.socket, addr: Any) -> None:
+        with self._lock:
+            if self._draining:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            cid = self._next_conn
+            self._next_conn += 1
+            thread = threading.Thread(
+                target=self._serve, args=(cid, sock),
+                name=f"gw-conn-{cid}", daemon=True,
+            )
+            self._conns[cid] = _Conn(cid, sock, addr, thread)
+            thread.start()
+
+    def _unregister(self, cid: int) -> None:
+        with self._lock:
+            self._conns.pop(cid, None)
+
+    # ---------------------------------------------------------- reader thread
+    def _serve(self, cid: int, sock: socket.socket) -> None:
+        reader = sock.makefile("rb")
+        session: Optional[str] = None
+        try:
+            while True:
+                try:
+                    line = reader.readline(protocol.MAX_FRAME_BYTES + 1)
+                except OSError:
+                    break
+                if not line:
+                    break  # EOF: client hung up (or drain half-closed us)
+                arrival = time.monotonic()
+                rid: Any = None
+                try:
+                    frame = protocol.decode_frame(line)
+                    rid = frame.get("rid")
+                    session = frame.get("session") or session
+                    result = self._dispatch(frame, session, arrival)
+                    resp = protocol.ok_response(rid, result)
+                except GatewayError as e:
+                    resp = protocol.error_response(rid, e)
+                    if e.code == protocol.GW_BADFRAME:
+                        self._send(sock, resp)
+                        break  # stream integrity is gone; drop the conn
+                except SimulatedKill as e:
+                    # The crash harness 'SIGKILL'ed us mid-request — a real
+                    # kill takes the whole gateway, so no response (the ACK
+                    # dies on the floor), no drain marker, every socket cut.
+                    self._die(e)
+                    return
+                except Exception as e:
+                    logger.exception(
+                        "gateway: unexpected error serving conn %d", cid
+                    )
+                    resp = protocol.error_response(
+                        rid, protocol.classify_exception(e)
+                    )
+                if not self._send(sock, resp):
+                    break
+        finally:
+            try:
+                reader.close()
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._unregister(cid)
+
+    def _die(self, exc: BaseException) -> None:
+        """Simulated whole-gateway death: cut everything, journal nothing.
+        Recovery is the next incarnation's problem — that's the point."""
+        with self._lock:
+            self.killed = True
+            self._draining = True   # accept loop exits at its next poll
+            conns = list(self._conns.values())
+        listener = self._listener
+        if listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        logger.warning("gateway killed by crash harness: %s", exc)
+
+    @staticmethod
+    def _send(sock: socket.socket, resp: Dict[str, Any]) -> bool:
+        try:
+            sock.sendall(protocol.encode_frame(resp))
+        except (OSError, GatewayError):
+            return False
+        return True
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, frame: Dict[str, Any], session: Optional[str],
+                  arrival: float) -> Dict[str, Any]:
+        op = frame.get("op")
+        if op == "submit":
+            return self._op_submit(frame, session, arrival)
+        if op == "status":
+            return self._op_status(frame)
+        if op == "wait":
+            return self._op_wait(frame)
+        if op == "cancel":
+            return self._op_cancel(frame)
+        if op == "hello":
+            return self._op_hello(frame, session)
+        if op == "ping":
+            with self._lock:
+                draining = self._draining
+            return {"pong": True, "draining": draining}
+        raise GatewayError(protocol.GW_BADREQUEST, f"unknown op {op!r}")
+
+    def _op_hello(self, frame: Dict[str, Any],
+                  session: Optional[str]) -> Dict[str, Any]:
+        if not session:
+            raise GatewayError(protocol.GW_BADREQUEST,
+                               "hello needs a session id")
+        with self._lock:
+            sess = self._sessions.get(session)
+            resumed = sess is not None
+            if sess is None:
+                sess = self._sessions[session] = _Session(session)
+            sess.connects += 1
+            live = sum(
+                1 for jid in sess.jobs if self._live_state(jid)
+            )
+        return {"proto": protocol.PROTO_VERSION, "resumed": resumed,
+                "live_jobs": live}
+
+    def _op_submit(self, frame: Dict[str, Any], session: Optional[str],
+                   arrival: float) -> Dict[str, Any]:
+        sched_point("gateway.submit")
+        with self._lock:
+            if self._draining:
+                raise GatewayError(
+                    protocol.GW_DRAINING,
+                    "gateway is draining; retry against the next incarnation",
+                )
+        self._check_deadline(frame, arrival, session, "submit")
+        job = frame.get("job")
+        if not isinstance(job, dict) or not job.get("name"):
+            raise GatewayError(protocol.GW_BADREQUEST,
+                               "submit needs a job object with a name")
+        key = frame.get("dedup_key")
+        sched_point("gateway.dedup")
+        with self._dedup_lock:
+            if key is not None:
+                jid = self._dedup.get(key)
+                if jid is not None:
+                    # Idempotent retry: the original admission stands; the
+                    # lost-ACK window (connection drop, mid-ACK kill,
+                    # gateway restart) collapses to a lookup.
+                    self._dedup_hits += 1
+                    self._note_session_job(session, jid)
+                    jnl = self.service.journal
+                    if jnl is not None:
+                        jnl.append("gateway_dedup_hit", key=key, job=jid,
+                                   session=session)
+                    metrics.event("gateway_dedup_hit", key=key, job=jid,
+                                  session=session)
+                    return {"job_id": jid, "duplicate": True}
+            # Shed expired work before admission: time spent waiting out the
+            # dedup lock (the gateway's admission queue) counts against the
+            # request's budget.
+            self._check_deadline(frame, arrival, session, "submit")
+            self._check_window(session)
+            task = self._build_task(job)
+            req = JobRequest(
+                task=task,
+                priority=float(job.get("priority", 0.0)),
+                deadline_s=job.get("deadline_s"),
+                max_retries=int(job.get("max_retries", 1)),
+                spec=job.get("spec"),
+                dedup_key=key,
+            )
+            try:
+                rec = self.service.queue.submit(req)
+            except (ValueError, RuntimeError) as e:
+                raise protocol.classify_exception(e) from e
+            # submit() returning IS the durable ack on a durable service:
+            # the job_submitted record (dedup key included) is fsync'd.
+            if key is not None:
+                self._dedup[key] = rec.job_id
+            self._note_session_job(session, rec.job_id)
+        return {"job_id": rec.job_id, "duplicate": False}
+
+    def _op_status(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        jid = self._job_id(frame)
+        try:
+            rec = self.service.queue.get(jid)
+        except KeyError as e:
+            raise protocol.classify_exception(e) from e
+        return rec.snapshot()
+
+    def _op_wait(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        jid = self._job_id(frame)
+        chunk = min(float(frame.get("timeout_s") or self.wait_chunk_cap_s),
+                    self.wait_chunk_cap_s)
+        try:
+            rec = self.service.queue.wait(jid, timeout=max(chunk, 0.0))
+        except KeyError as e:
+            raise protocol.classify_exception(e) from e
+        except TimeoutError:
+            snap = self.service.queue.get(jid).snapshot()
+            return dict(snap, terminal=False)
+        return dict(rec.snapshot(), terminal=True)
+
+    def _op_cancel(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        jid = self._job_id(frame)
+        try:
+            cancelled = self.service.queue.cancel(jid)
+        except KeyError as e:
+            raise protocol.classify_exception(e) from e
+        return {"cancelled": cancelled}
+
+    # -------------------------------------------------------------- helpers
+    @staticmethod
+    def _job_id(frame: Dict[str, Any]) -> str:
+        jid = frame.get("job")
+        if not isinstance(jid, str) or not jid:
+            raise GatewayError(protocol.GW_BADREQUEST,
+                               "request needs a job id")
+        return jid
+
+    def _live_state(self, jid: str) -> bool:
+        try:
+            rec = self.service.queue.get(jid)
+        except KeyError:
+            return False
+        return rec.state not in TERMINAL_STATES
+
+    def _session(self, sid: Optional[str]) -> Optional[_Session]:
+        if sid is None:
+            return None
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                sess = self._sessions[sid] = _Session(sid)
+            return sess
+
+    def _note_session_job(self, sid: Optional[str], jid: str) -> None:
+        if sid is None:
+            return
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                sess = self._sessions[sid] = _Session(sid)
+            sess.jobs.add(jid)
+
+    def _check_deadline(self, frame: Dict[str, Any], arrival: float,
+                        session: Optional[str], op: str) -> None:
+        deadline_s = frame.get("deadline_s")
+        if deadline_s is None:
+            return
+        expired = time.monotonic() - arrival >= float(deadline_s)
+        if expired or float(deadline_s) <= 0:
+            self._shed("deadline_expired", session, op)
+            raise GatewayError(
+                protocol.GW_DEADLINE_EXPIRED,
+                f"request budget of {deadline_s}s elapsed before admission",
+            )
+
+    def _pressure_active(self) -> bool:
+        last = getattr(self.service, "last_pressure_shed", None)
+        return (last is not None
+                and time.monotonic() - last < self.pressure_cooldown_s)
+
+    def _check_window(self, session: Optional[str]) -> None:
+        window = self.max_inflight
+        pressured = self._pressure_active()
+        if pressured:
+            # The deadline-pressure shedder is evicting admitted work:
+            # stop feeding it from the wire until the cooldown passes.
+            window = max(1, int(window * self.pressure_window_factor))
+        live = self.service.queue.live()
+        if live >= window:
+            self._shed("retry_after", session, "submit")
+            raise GatewayError(
+                protocol.GW_RETRY_AFTER,
+                f"{live} live job(s) >= window {window}"
+                + (" (pressure-shrunk)" if pressured else ""),
+                retry_after_s=self.retry_after_s,
+            )
+        if session is not None:
+            with self._lock:
+                sess = self._sessions.get(session)
+                jobs = list(sess.jobs) if sess is not None else []
+            sess_live = sum(1 for jid in jobs if self._live_state(jid))
+            if sess_live >= self.max_inflight_per_session:
+                self._shed("retry_after_session", session, "submit")
+                raise GatewayError(
+                    protocol.GW_RETRY_AFTER,
+                    f"session {session} has {sess_live} live job(s) >= "
+                    f"per-session window {self.max_inflight_per_session}",
+                    retry_after_s=self.retry_after_s,
+                )
+
+    def _shed(self, reason: str, session: Optional[str], op: str) -> None:
+        with self._lock:
+            self._sheds[reason] = self._sheds.get(reason, 0) + 1
+        jnl = self.service.journal
+        if jnl is not None:
+            jnl.append("gateway_shed", reason=reason, session=session, op=op)
+        metrics.event("gateway_shed", reason=reason, session=session, op=op)
+
+    def _build_task(self, job: Dict[str, Any]) -> Any:
+        provider = self.service.task_provider
+        if provider is None:
+            raise GatewayError(
+                protocol.GW_BADREQUEST,
+                "wire submissions need SaturnService(task_provider=...) to "
+                "rebuild task objects from job specs",
+            )
+        name = job["name"]
+        total = int(job.get("total_batches") or 0)
+        # Same payload contract as crash recovery's build_restore_records:
+        # one provider serves both paths.
+        task = provider({
+            "job_id": None,
+            "task": name,
+            "total_batches": total,
+            "remaining_batches": total,
+            "priority": float(job.get("priority", 0.0)),
+            "deadline_s": job.get("deadline_s"),
+            "max_retries": int(job.get("max_retries", 1)),
+            "spec": job.get("spec"),
+        })
+        if getattr(task, "name", None) != name:
+            raise GatewayError(
+                protocol.GW_INTERNAL,
+                f"task_provider returned {getattr(task, 'name', None)!r} "
+                f"for submitted name {name!r}",
+            )
+        return task
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        """Point-in-time gateway counters (operator/test visibility)."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "connections": len(self._conns),
+                "sessions": len(self._sessions),
+                "sheds": dict(self._sheds),
+                "draining": self._draining,
+            }
+        with self._dedup_lock:
+            out["dedup_entries"] = len(self._dedup)
+            out["dedup_hits"] = self._dedup_hits
+        return out

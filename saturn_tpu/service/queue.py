@@ -81,6 +81,11 @@ class JobRequest:
     #                                    submission and handed back to
     #                                    ``task_provider`` on crash recovery
     #                                    so the task object can be rebuilt
+    dedup_key: Optional[str] = None    # gateway idempotency key: journaled
+    #                                    inside the job_submitted record so a
+    #                                    retried network submit (lost ACK,
+    #                                    gateway restart) maps back to this
+    #                                    job id instead of admitting twice
 
 
 @dataclass

@@ -140,6 +140,14 @@ class SaturnService:
         self.killed = False
         self._recovered_plan: Optional[milp.Plan] = None
         self._recovered_health: Optional[tuple] = None
+        #: dedup_key -> job_id replayed from the journal: the network
+        #: gateway seeds its idempotency table from this so a client retry
+        #: that straddles a restart still maps to the original admission.
+        self.recovered_dedup: Dict[str, str] = {}
+        #: monotonic timestamp of the last admission-pressure eviction; the
+        #: gateway reads it to shrink its inflight window while the shedder
+        #: is active (wire-level backpressure follows mesh-level pressure).
+        self.last_pressure_shed: Optional[float] = None
         if durability_dir is not None:
             self._recover_from(durability_dir, crash_barrier)
         elif crash_barrier is not None:
@@ -174,6 +182,7 @@ class SaturnService:
         self.queue.observer = self._observe_job
         self.admission.journal = self.journal
         state = rmod.replay_service_state(durability_dir)
+        self.recovered_dedup = dict(state.dedup)
         if state.checkpoints:
             rmod.reconcile_checkpoints(state.checkpoints)
         if state.jobs:
@@ -254,6 +263,7 @@ class SaturnService:
                 max_retries=rec.request.max_retries,
                 total_batches=getattr(rec.task, "total_batches", None),
                 spec=rec.request.spec,
+                dedup_key=rec.request.dedup_key,
             )
         elif event == "recovered":
             jnl.append(
@@ -754,6 +764,10 @@ class SaturnService:
             change_kind="admission-pressure", degrade_factor=1.0,
         )
         _keep, shed = get_policy(self.pressure_policy)(tasks, ctx)
+        if shed:
+            # Signal wire-level backpressure: the gateway shrinks its
+            # admission window while this timestamp is fresh.
+            self.last_pressure_shed = time.monotonic()
         for t in shed:
             rec = jobs.get(t.name)
             if rec is not None:

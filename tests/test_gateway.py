@@ -1,0 +1,621 @@
+"""Network gateway: wire protocol, idempotent retries, netchaos acceptance.
+
+Everything runs against the real service loop on the 8 virtual CPU devices
+from conftest, with real TCP sockets on loopback. The acceptance campaign at
+the bottom is the ISSUE's scenario: seeds × wire-fault classes (connection
+drops, duplicated/reordered frames, partial writes, mid-ACK kills) plus a
+gateway kill-and-restart against the same journal, asserting **zero lost
+jobs, zero duplicate admissions**, and surviving jobs' journaled
+trajectories identical to an in-process run of the same mix.
+"""
+
+import threading
+import time
+
+import pytest
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.core.technique import BaseTechnique
+from saturn_tpu.durability.recovery import replay_service_state
+from saturn_tpu.resilience.crash import CrashInjector
+from saturn_tpu.resilience.netchaos import (
+    NET_FAULT_CLASSES,
+    NetChaosProxy,
+    NetChaosSpec,
+    single_fault_spec,
+)
+from saturn_tpu.service import (
+    GatewayClient,
+    GatewayError,
+    GatewayServer,
+    SaturnService,
+    ServiceClient,
+)
+from saturn_tpu.service.gateway import protocol
+
+pytestmark = pytest.mark.gateway
+
+
+class FakeDev:
+    pass
+
+
+def topo(n=8):
+    return SliceTopology([FakeDev() for _ in range(n)])
+
+
+class RecordingTech(BaseTechnique):
+    """Sleeps per batch; records (task, block-size) launches."""
+
+    name = "gw-fake"
+
+    def __init__(self, per_batch=0.001):
+        self.per_batch = per_batch
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def execute(self, task, devices, tid, override_batch_count=None):
+        with self.lock:
+            self.calls.append((task.name, len(devices)))
+        time.sleep(self.per_batch * (override_batch_count or 1))
+
+    def search(self, task, devices, tid):
+        return {}, self.per_batch
+
+
+class FakeTask:
+    """Duck-typed pre-profiled task (admission skips the trial sweep)."""
+
+    def __init__(self, name, total_batches, sizes, tech, pbt=0.001):
+        self.name = name
+        self.total_batches = total_batches
+        self.current_batch = 0
+        self.epoch_length = 1000
+        self.hints = {}
+        self.chip_range = None
+        self.strategies = {
+            g: Strategy(tech, g, {}, pbt * total_batches, pbt) for g in sizes
+        }
+        self.selected_strategy = None
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+    def select_strategy(self, g):
+        self.selected_strategy = self.strategies[g]
+
+    def reconfigure(self, n):
+        self.current_batch = (self.current_batch + n) % self.epoch_length
+
+
+def _provider(tech):
+    """The one task-rebuild contract serving both wire submits and crash
+    recovery: payload -> fresh FakeTask."""
+
+    def provide(payload):
+        return FakeTask(
+            payload["task"], payload["remaining_batches"],
+            payload["spec"]["sizes"], tech, pbt=0.004,
+        )
+
+    return provide
+
+
+def _service(tech, wal=None, barrier=None, start=True, **kw):
+    svc = SaturnService(
+        topology=topo(8), interval=0.2, poll_s=0.02,
+        durability_dir=wal, task_provider=_provider(tech),
+        crash_barrier=barrier, health_guardian=False, **kw,
+    )
+    return svc.start() if start else svc
+
+
+SPEC = {"sizes": [4, 8]}
+
+
+# ----------------------------------------------------------------- protocol
+class TestProtocol:
+    def test_frame_roundtrip(self):
+        frame = {"op": "submit", "rid": "s:1", "job": {"name": "a"}}
+        assert protocol.decode_frame(protocol.encode_frame(frame)) == frame
+
+    def test_decode_rejects_garbage_and_non_objects(self):
+        for raw in (b"not json\n", b"[1,2]\n", b"\xff\xfe\n"):
+            with pytest.raises(GatewayError) as ei:
+                protocol.decode_frame(raw)
+            assert ei.value.code == protocol.GW_BADFRAME
+
+    def test_oversized_frame_refused_both_ways(self):
+        big = {"op": "submit", "blob": "x" * protocol.MAX_FRAME_BYTES}
+        with pytest.raises(GatewayError):
+            protocol.encode_frame(big)
+        with pytest.raises(GatewayError):
+            protocol.decode_frame(b"x" * (protocol.MAX_FRAME_BYTES + 1))
+
+    def test_error_codes_are_closed(self):
+        with pytest.raises(ValueError):
+            GatewayError("GW_NOT_A_CODE")
+
+    def test_error_round_trips_losslessly(self):
+        for code in protocol.ERROR_CODES:
+            e = GatewayError(code, "why it failed", retry_after_s=(
+                0.25 if code == protocol.GW_RETRY_AFTER else None))
+            back = GatewayError.from_wire(e.to_wire())
+            assert back.code == e.code
+            assert back.message == e.message
+            assert back.retriable == e.retriable
+            assert back.retry_after_s == e.retry_after_s
+
+    def test_from_wire_tolerates_malformed_payloads(self):
+        for payload in (None, "boom", {"code": "GW_NOPE", "message": "m"}):
+            e = GatewayError.from_wire(payload)
+            assert e.code == protocol.GW_INTERNAL
+
+    def test_retriable_defaults_follow_the_code_class(self):
+        assert GatewayError(protocol.GW_RETRY_AFTER).retriable
+        assert GatewayError(protocol.GW_DRAINING).retriable
+        assert not GatewayError(protocol.GW_DUPLICATE_NAME).retriable
+        assert not GatewayError(protocol.GW_INTERNAL).retriable
+
+    def test_classify_maps_service_exceptions_to_typed_codes(self):
+        dup = ValueError("task name 'a' is already live as j0001-a")
+        assert (protocol.classify_exception(dup).code
+                == protocol.GW_DUPLICATE_NAME)
+        assert (protocol.classify_exception(KeyError("unknown job id")).code
+                == protocol.GW_UNKNOWN_JOB)
+        assert (protocol.classify_exception(ValueError("bad field")).code
+                == protocol.GW_BADREQUEST)
+        internal = protocol.classify_exception(RuntimeError("boom"))
+        assert internal.code == protocol.GW_INTERNAL
+        assert "RuntimeError" in internal.message
+
+
+# ------------------------------------------------------------ basic surface
+class TestGatewaySurface:
+    def test_submit_wait_status_cancel_over_the_wire(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc).start()
+        try:
+            with GatewayClient(*gw.address, seed=1) as c:
+                jid = c.submit(name="wire-a", total_batches=5, spec=SPEC)
+                snap = c.status(jid)
+                assert snap["job_id"] == jid and snap["task"] == "wire-a"
+                done = c.wait(jid, timeout=60)
+                assert done["state"] == "DONE"
+                # cancel an already-terminal job -> False, like ServiceClient
+                assert c.cancel(jid) is False
+                assert c.ping()["pong"] is True
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+    def test_duplicate_live_name_is_a_typed_wire_error(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc).start()
+        try:
+            with GatewayClient(*gw.address, seed=2) as c:
+                c.submit(name="dup-name", total_batches=50, spec=SPEC)
+                with pytest.raises(GatewayError) as ei:
+                    c.submit(name="dup-name", total_batches=5, spec=SPEC)
+                assert ei.value.code == protocol.GW_DUPLICATE_NAME
+                assert not ei.value.retriable
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+    def test_unknown_job_and_bad_op_errors(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc).start()
+        try:
+            with GatewayClient(*gw.address, seed=3) as c:
+                with pytest.raises(GatewayError) as ei:
+                    c.status("j9999-nope")
+                assert ei.value.code == protocol.GW_UNKNOWN_JOB
+                with pytest.raises(GatewayError) as ei:
+                    c._call({"op": "frobnicate"})
+                assert ei.value.code == protocol.GW_BADREQUEST
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+    def test_same_dedup_key_returns_original_job_id(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc).start()
+        try:
+            with GatewayClient(*gw.address, seed=4) as c:
+                key = "retry:me:1"
+                a = c.submit(name="idem", total_batches=5, spec=SPEC,
+                             dedup_key=key)
+                b = c.submit(name="idem", total_batches=5, spec=SPEC,
+                             dedup_key=key)
+                assert a == b
+                assert gw.stats()["dedup_hits"] == 1
+                assert c.wait(a, timeout=60)["state"] == "DONE"
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+
+# ---------------------------------------------------- deadlines/backpressure
+class TestAdmissionControls:
+    def test_expired_request_deadline_sheds_before_admission(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc).start()
+        try:
+            with GatewayClient(*gw.address, seed=5) as c:
+                with pytest.raises(GatewayError) as ei:
+                    c.submit(name="late", total_batches=5, spec=SPEC,
+                             request_deadline_s=0.0)
+                assert ei.value.code == protocol.GW_DEADLINE_EXPIRED
+                assert gw.stats()["sheds"] == {"deadline_expired": 1}
+                # the shed left no job behind
+                assert all(r.name != "late" for r in svc.queue.jobs())
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+    def test_global_window_backpressure_returns_retry_after(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc, max_inflight=1).start()
+        try:
+            with GatewayClient(*gw.address, seed=6, max_attempts=1) as c:
+                c.submit(name="bp-a", total_batches=2000, spec=SPEC)
+                with pytest.raises(GatewayError) as ei:
+                    c.submit(name="bp-b", total_batches=5, spec=SPEC)
+                # one transport attempt: the raw verdict, not the retry loop
+                e = ei.value
+                assert e.code in (protocol.GW_RETRY_AFTER,
+                                  protocol.GW_UNAVAILABLE)
+                if e.code == protocol.GW_RETRY_AFTER:
+                    assert e.retriable and e.retry_after_s > 0
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+    def test_retry_after_clears_once_the_window_frees(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc, max_inflight=1, retry_after_s=0.1).start()
+        try:
+            with GatewayClient(*gw.address, seed=7, max_attempts=30,
+                               backoff_base_s=0.05) as c:
+                a = c.submit(name="win-a", total_batches=3, spec=SPEC)
+                # retries through GW_RETRY_AFTER until win-a completes
+                b = c.submit(name="win-b", total_batches=3, spec=SPEC)
+                assert c.wait(a, timeout=60)["state"] == "DONE"
+                assert c.wait(b, timeout=60)["state"] == "DONE"
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+    def test_pressure_shed_signal_shrinks_the_window(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc, max_inflight=8,
+                           pressure_window_factor=0.25).start()
+        try:
+            # Fake the service's deadline-pressure shedder having just fired:
+            # effective window = max(1, 8*0.25) = 2.
+            svc.last_pressure_shed = time.monotonic()
+            with GatewayClient(*gw.address, seed=8, max_attempts=1) as c:
+                c.submit(name="pw-a", total_batches=2000, spec=SPEC)
+                c.submit(name="pw-b", total_batches=2000, spec=SPEC)
+                with pytest.raises(GatewayError) as ei:
+                    c.submit(name="pw-c", total_batches=5, spec=SPEC)
+                assert ei.value.code in (protocol.GW_RETRY_AFTER,
+                                         protocol.GW_UNAVAILABLE)
+                assert "pressure-shrunk" in ei.value.message or \
+                    ei.value.code == protocol.GW_UNAVAILABLE
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+    def test_per_session_window(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc, max_inflight=64,
+                           max_inflight_per_session=1).start()
+        try:
+            with GatewayClient(*gw.address, seed=9, max_attempts=1) as c:
+                c.submit(name="sw-a", total_batches=2000, spec=SPEC)
+                with pytest.raises(GatewayError):
+                    c.submit(name="sw-b", total_batches=5, spec=SPEC)
+            # a different session still fits the global window
+            with GatewayClient(*gw.address, seed=10, max_attempts=1) as c2:
+                c2.submit(name="sw-c", total_batches=5, spec=SPEC)
+        finally:
+            gw.shutdown(reason="test")
+            svc.stop(abort=True, timeout=30)
+
+
+# -------------------------------------------------------------------- drain
+class TestDrain:
+    def test_drain_refuses_submits_flushes_and_journals_marker(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        tech = RecordingTech()
+        svc = _service(tech, wal=wal)
+        gw = GatewayServer(svc).start()
+        with GatewayClient(*gw.address, seed=11) as c:
+            jid = c.submit(name="drain-a", total_batches=5, spec=SPEC)
+            assert gw.shutdown(timeout=10.0, reason="SIGTERM") is True
+            # live connection: in-flight work still answers, new work refused
+            with pytest.raises(GatewayError) as ei:
+                c.submit(name="drain-b", total_batches=5, spec=SPEC)
+            assert ei.value.code in (protocol.GW_DRAINING,
+                                     protocol.GW_UNAVAILABLE)
+        svc.stop(abort=True, timeout=30)
+        # durable handoff marker, with the ledger
+        from saturn_tpu.durability import journal as jmod
+
+        drains = [r for r in jmod.replay(wal) if r["kind"] == "gateway_drain"]
+        assert len(drains) == 1
+        d = drains[0]["data"]
+        assert d["reason"] == "SIGTERM" and d["clean"] is True
+        assert d["dedup_entries"] == 1
+        assert jid  # the admitted job survived in the journal too
+        state = replay_service_state(wal)
+        assert jid in state.jobs
+        # Operator view: the analysis CLI reads the same ledger back.
+        import json
+
+        from saturn_tpu.analysis import cli as acli
+
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = acli.main(["--json", "gateway", wal])
+        assert rc == 0
+        view = json.loads(buf.getvalue())
+        assert view["submitted"] == 1
+        assert view["dedup_entries"] == 1
+        assert view["last_drain_clean"] is True
+        assert view["drains"][0]["reason"] == "SIGTERM"
+
+    def test_wait_drained_blocks_until_marker_journaled(self, tmp_path):
+        """The SIGTERM pattern: shutdown on a separate thread, the host
+        waits on wait_drained() — by the time it returns, the durable
+        marker must already be in the journal."""
+        wal = str(tmp_path / "wal")
+        tech = RecordingTech()
+        svc = _service(tech, wal=wal)
+        gw = GatewayServer(svc).start()
+        assert gw.wait_drained(timeout=0.05) is False  # not draining yet
+        t = threading.Thread(
+            target=gw.shutdown, kwargs={"reason": "SIGTERM"}, daemon=True
+        )
+        t.start()
+        assert gw.wait_drained(timeout=10.0) is True
+        from saturn_tpu.durability import journal as jmod
+
+        kinds = [r["kind"] for r in jmod.replay(wal)]
+        assert "gateway_drain" in kinds
+        t.join(timeout=5.0)
+        svc.stop(abort=True, timeout=30)
+
+    def test_new_connections_refused_while_draining(self, tmp_path):
+        tech = RecordingTech()
+        svc = _service(tech, wal=str(tmp_path / "wal"))
+        gw = GatewayServer(svc).start()
+        gw.shutdown(timeout=5.0, reason="test")
+        with pytest.raises(GatewayError) as ei:
+            GatewayClient(*gw.address, seed=12, max_attempts=2,
+                          timeout_s=1.0, backoff_base_s=0.01).ping()
+        assert ei.value.code == protocol.GW_UNAVAILABLE
+        svc.stop(abort=True, timeout=30)
+
+
+# -------------------------------------------------------------- kill-replay
+@pytest.mark.crash
+class TestKillReplay:
+    def test_ack_cut_by_gateway_kill_recovers_idempotently(self, tmp_path):
+        """The canonical lost-ACK crash: the submit's journal commit lands,
+        the crash harness kills the gateway before the ACK frame is written,
+        and the client's retry AGAINST THE NEXT INCARNATION (same journal)
+        gets the original job id — exactly-once across restarts."""
+        wal = str(tmp_path / "wal")
+        tech = RecordingTech()
+        # No service loop: the submit path needs only queue+journal, and an
+        # idle loop would race the injector for barrier crossings.
+        inj = CrashInjector("post-commit", hit=1, armed=False)
+        svc = _service(tech, wal=wal, barrier=inj.barrier, start=False)
+        gw = GatewayServer(svc).start()
+        key = "kill:me:1"
+        inj.arm()
+        with pytest.raises(GatewayError) as ei:
+            GatewayClient(*gw.address, session="killer", seed=13,
+                          max_attempts=2, timeout_s=2.0,
+                          backoff_base_s=0.01).submit(
+                name="kill-a", total_batches=4, spec=SPEC, dedup_key=key)
+        assert ei.value.code == protocol.GW_UNAVAILABLE
+        assert inj.fired.is_set() and gw.killed
+        # The admission was durable before the kill point...
+        state = replay_service_state(wal)
+        assert state.dedup.get(key) is not None
+        original = state.dedup[key]
+        # ...so the next incarnation answers the retry with the original id.
+        tech2 = RecordingTech()
+        svc2 = _service(tech2, wal=wal)
+        gw2 = GatewayServer(svc2).start()
+        try:
+            with GatewayClient(*gw2.address, session="killer", seed=13) as c:
+                jid = c.submit(name="kill-a", total_batches=4, spec=SPEC,
+                               dedup_key=key)
+                assert jid == original
+                assert c.wait(jid, timeout=60)["state"] == "DONE"
+            # exactly one admission for the key across both incarnations
+            final = replay_service_state(wal)
+            submitted = [j for j in final.jobs.values()
+                         if j.dedup_key == key]
+            assert len(submitted) == 1 and submitted[0].job_id == original
+        finally:
+            gw2.shutdown(reason="test")
+            svc2.stop(abort=True, timeout=30)
+
+
+# ------------------------------------------------------- netchaos acceptance
+def _trajectory(wal):
+    """A run's journaled outcome, in comparison form: per job name, the
+    final lifecycle state and the durably realized batches. Two runs of the
+    same mix must produce identical trajectories — same jobs, same
+    verdicts, same amount of work, no phantom admissions."""
+    state = replay_service_state(wal)
+    out = {}
+    for j in state.jobs.values():
+        assert j.task not in out, f"duplicate admission for {j.task}"
+        out[j.task] = (j.state, j.realized, j.total_batches)
+    return out
+
+
+def _job_mix(n=6):
+    return [(f"mix-{i}", 3 + (i % 3)) for i in range(n)]
+
+
+def _run_in_process(wal, mix):
+    """Reference run: the same job mix through the in-process client."""
+    tech = RecordingTech()
+    svc = _service(tech, wal=wal)
+    try:
+        client = ServiceClient(svc)
+        ids = [client.submit(FakeTask(name, total, SPEC["sizes"], tech,
+                                      pbt=0.004),
+                             spec={"sizes": SPEC["sizes"]})
+               for name, total in mix]
+        for jid in ids:
+            assert client.wait(jid, timeout=60)["state"] == "DONE"
+    finally:
+        svc.stop(timeout=60)
+    return _trajectory(wal)
+
+
+def _run_through_chaos(wal, mix, spec):
+    """Same mix, but over TCP through the seeded chaos proxy."""
+    tech = RecordingTech()
+    svc = _service(tech, wal=wal)
+    gw = GatewayServer(svc).start()
+    try:
+        with NetChaosProxy(*gw.address, spec) as px:
+            with GatewayClient(*px.address, seed=spec.seed,
+                               timeout_s=5.0, max_attempts=10) as c:
+                ids = [c.submit(name=name, total_batches=total, spec=SPEC)
+                       for name, total in mix]
+                for jid in ids:
+                    assert c.wait(jid, timeout=90)["state"] == "DONE", jid
+            stats = px.stats
+    finally:
+        gw.shutdown(reason="campaign")
+        svc.stop(timeout=60)
+    return _trajectory(wal), stats
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_netchaos_campaign_zero_lost_zero_duplicated(seed, tmp_path):
+    """Seeds × fault classes: every class injected at least somewhere across
+    the sweep, and for every (seed, class) cell the chaos run's trajectory
+    equals the clean in-process reference — zero lost jobs, zero duplicate
+    admissions, same realized work."""
+    mix = _job_mix()
+    reference = _run_in_process(str(tmp_path / "ref"), mix)
+    assert all(st == "DONE" and r >= t for st, r, t in reference.values())
+    injected_anywhere = {}
+    for fc in ("drop", "dup", "partial", "kill_ack"):
+        wal = str(tmp_path / f"chaos-{fc}")
+        spec = single_fault_spec(seed=seed, fault_class=fc,
+                                 max_faults_per_conn=2)
+        trajectory, stats = _run_through_chaos(wal, mix, spec)
+        assert trajectory == reference, (
+            f"{fc}: chaos trajectory diverged from the in-process reference"
+        )
+        for k, v in stats.injected.items():
+            injected_anywhere[k] = injected_anywhere.get(k, 0) + v
+    assert set(injected_anywhere) == {"drop", "dup", "partial", "kill_ack"}, (
+        f"campaign never injected some classes: {injected_anywhere}"
+    )
+
+
+@pytest.mark.slow
+def test_netchaos_mixed_faults_with_gateway_kill_and_restart(tmp_path):
+    """The full acceptance scenario: mixed wire faults AND a gateway kill
+    mid-campaign, restart against the same journal, campaign completes with
+    zero lost and zero duplicated jobs."""
+    mix = _job_mix(5)
+    reference = _run_in_process(str(tmp_path / "ref"), mix)
+    wal = str(tmp_path / "chaos")
+    spec = NetChaosSpec(seed=31, fault_rate=0.3, max_faults_per_conn=2)
+
+    tech = RecordingTech()
+    inj = CrashInjector("post-commit", hit=2, armed=True)
+    svc = _service(tech, wal=wal, barrier=inj.barrier, start=False)
+    gw = GatewayServer(svc).start()
+    survivors = {}
+    with NetChaosProxy(*gw.address, spec) as px:
+        c = GatewayClient(*px.address, session="camp", seed=spec.seed,
+                          timeout_s=3.0, max_attempts=3,
+                          backoff_base_s=0.02)
+        keys = {name: f"camp:{name}" for name, _ in mix}
+        for name, total in mix:
+            try:
+                survivors[name] = c.submit(
+                    name=name, total_batches=total, spec=SPEC,
+                    dedup_key=keys[name])
+            except GatewayError:
+                pass  # lost to the kill window — retried after restart
+        c.close()
+    assert inj.fired.is_set() and gw.killed  # the kill landed mid-campaign
+
+    # Restart: same journal, fresh service+gateway; the client retries every
+    # submit with its original dedup key, then drives all jobs to DONE.
+    tech2 = RecordingTech()
+    svc2 = _service(tech2, wal=wal)
+    gw2 = GatewayServer(svc2).start()
+    try:
+        with NetChaosProxy(*gw2.address, spec) as px2:
+            with GatewayClient(*px2.address, session="camp", seed=spec.seed,
+                               timeout_s=5.0, max_attempts=10) as c2:
+                ids = {}
+                for name, total in mix:
+                    ids[name] = c2.submit(name=name, total_batches=total,
+                                          spec=SPEC, dedup_key=keys[name])
+                for name, jid in sorted(ids.items()):
+                    # idempotency across the kill: pre-kill admissions keep
+                    # their ids through the retry
+                    if name in survivors:
+                        assert jid == survivors[name], name
+                    assert c2.wait(jid, timeout=90)["state"] == "DONE", name
+    finally:
+        gw2.shutdown(reason="campaign")
+        svc2.stop(timeout=60)
+
+    trajectory = _trajectory(wal)   # asserts zero duplicate admissions
+    assert trajectory == reference  # zero lost, same realized work
+
+
+# ----------------------------------------------------------- session resume
+def test_session_resume_after_reconnect(tmp_path):
+    tech = RecordingTech()
+    svc = _service(tech, wal=str(tmp_path / "wal"))
+    gw = GatewayServer(svc, max_inflight_per_session=1).start()
+    try:
+        c = GatewayClient(*gw.address, session="resume-me", seed=14,
+                          max_attempts=1)
+        c.submit(name="rs-a", total_batches=2000, spec=SPEC)
+        c.close()
+        # a NEW connection with the SAME session id inherits the window
+        c2 = GatewayClient(*gw.address, session="resume-me", seed=15,
+                           max_attempts=1)
+        with pytest.raises(GatewayError):
+            c2.submit(name="rs-b", total_batches=5, spec=SPEC)
+        c2.close()
+        assert gw.stats()["sessions"] == 1
+    finally:
+        gw.shutdown(reason="test")
+        svc.stop(abort=True, timeout=30)
