@@ -214,6 +214,82 @@ def validate_online_row(row) -> list:
     return problems
 
 
+#: Required key -> type for the ``benchmarks/solver_scaling.py`` row. Same
+#: contract as the other ROW_REQUIRED tables: the bench self-validates before
+#: printing, and recorded rows can be re-checked without re-running it.
+SOLVER_ROW_REQUIRED = {
+    "metric": str,
+    "mode": str,                 # "quick" or "full"
+    "n_jobs": int,
+    "deadline_s": float,
+    "resolves": int,
+    "deadline_misses": int,      # hard acceptance bar: must be 0
+    "tier_counts": dict,         # tier name -> adoption count
+    "solve_p50_s": float,
+    "solve_p99_s": float,
+    "admission_p50_s": float,
+    "admission_p99_s": float,
+    "quality_delta_pct": float,  # anytime vs exact MILP on subsampled instances
+    "quality_samples": int,
+    "seed": int,
+    "status": str,
+}
+
+
+def validate_solver_row(row) -> list:
+    """Schema-check one solver-scaling row; returns human-readable problems
+    (empty list = valid)."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in SOLVER_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "solver_scaling":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'solver_scaling'"
+        )
+    if isinstance(row.get("n_jobs"), int) and row["n_jobs"] < 1:
+        problems.append(f"n_jobs {row['n_jobs']} < 1")
+    dm = row.get("deadline_misses")
+    if isinstance(dm, int) and not isinstance(dm, bool) and dm != 0:
+        problems.append(
+            f"deadline_misses {dm} != 0 (a re-solve blew its budget)"
+        )
+    for lo, hi in (("solve_p50_s", "solve_p99_s"),
+                   ("admission_p50_s", "admission_p99_s")):
+        a, b = row.get(lo), row.get(hi)
+        if (isinstance(a, (int, float)) and isinstance(b, (int, float))
+                and not isinstance(a, bool) and not isinstance(b, bool)
+                and b < a):
+            problems.append(f"{hi} < {lo}")
+    qd = row.get("quality_delta_pct")
+    if isinstance(qd, (int, float)) and not isinstance(qd, bool):
+        if qd > 10.0:
+            problems.append(
+                f"quality_delta_pct {qd} > 10 (anytime plan quality drifted "
+                "too far from the exact MILP)"
+            )
+    tc = row.get("tier_counts")
+    if isinstance(tc, dict):
+        bad = [k for k, v in tc.items()
+               if not isinstance(k, str)
+               or isinstance(v, bool) or not isinstance(v, int)]
+        if bad:
+            problems.append(f"tier_counts has non-(str -> int) entries: {bad}")
+    return problems
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (

@@ -181,35 +181,54 @@ def _online_provider(tech):
     return provide
 
 
-def run_gateway_phase(topo: SliceTopology) -> dict:
-    """Drive N_ONLINE jobs through the gateway under Poisson + bursts.
+def run_gateway_phase(topo: SliceTopology, *,
+                      n_jobs: int = N_ONLINE,
+                      window: int = GATEWAY_WINDOW,
+                      base_rate_hz: float = BASE_RATE_HZ,
+                      burst_rate_hz: float = BURST_RATE_HZ,
+                      interval: float = 0.2,
+                      batches: int = ONLINE_BATCHES,
+                      metrics_path: str = None,
+                      drain: bool = True,
+                      settle_s: float = 0.0,
+                      session_window: int = 16,
+                      seed: int = SEED) -> dict:
+    """Drive ``n_jobs`` jobs through the gateway under Poisson + bursts.
 
     Clients run with ``max_attempts=1`` on purpose: a shed is *counted*, not
     retried away — the row measures what the front door refused, and retry
     loops would hide exactly the behavior under test.
+
+    ``benchmarks/solver_scaling.py`` reuses this with the solver-depth
+    shape: ``window=n_jobs`` (nothing shed — queue depth is the point),
+    long ``batches`` so arrivals outlive the run, ``drain=False`` (reach
+    full depth and measure re-solves, don't wait out a multi-hour
+    makespan), and ``metrics_path`` to capture the ``solver_tier`` events.
     """
     tech = BenchTech()
     svc = SaturnService(
-        topology=topo, interval=0.2, poll_s=0.02,
+        topology=topo, interval=interval, poll_s=0.02,
         task_provider=_online_provider(tech), health_guardian=False,
+        metrics_path=metrics_path,
     ).start()
-    gw = GatewayServer(svc, max_inflight=GATEWAY_WINDOW)
+    gw = GatewayServer(svc, max_inflight=window,
+                       max_inflight_per_session=session_window)
     gw.start()
-    rng = random.Random(SEED)
+    rng = random.Random(seed)
     latencies, accepted, shed = [], [], 0
     t0 = time.monotonic()
     try:
         with GatewayClient(*gw.address, session="bench-online",
-                           seed=SEED, timeout_s=30.0,
+                           seed=seed, timeout_s=30.0,
                            max_attempts=1) as client:
-            for i in range(N_ONLINE):
+            for i in range(n_jobs):
                 in_burst = (i % BURST_EVERY) < BURST_LEN
-                rate = BURST_RATE_HZ if in_burst else BASE_RATE_HZ
+                rate = burst_rate_hz if in_burst else base_rate_hz
                 time.sleep(rng.expovariate(rate))
                 t_submit = time.monotonic()
                 try:
                     jid = client.submit(
-                        name=f"online-{i}", total_batches=ONLINE_BATCHES,
+                        name=f"online-{i}", total_batches=batches,
                         priority=float(rng.randint(0, 2)),
                         spec={"sizes": [4, 8]},
                     )
@@ -221,28 +240,33 @@ def run_gateway_phase(topo: SliceTopology) -> dict:
                     continue
                 latencies.append(time.monotonic() - t_submit)
                 accepted.append(jid)
-            for jid in accepted:
-                out = client.wait(jid, timeout=300)
-                if out["state"] != "DONE":
-                    raise SystemExit(f"gateway bench job not DONE: {out}")
+            if drain:
+                for jid in accepted:
+                    out = client.wait(jid, timeout=300)
+                    if out["state"] != "DONE":
+                        raise SystemExit(f"gateway bench job not DONE: {out}")
+            elif settle_s > 0:
+                time.sleep(settle_s)  # a few more re-solves at full depth
         makespan = time.monotonic() - t0
     finally:
         gw.shutdown(timeout=10, reason="bench-complete")
-        svc.stop(timeout=30)
+        # No-drain runs leave thousands of long jobs live on purpose —
+        # draining them would wait out the plan's full makespan.
+        svc.stop(abort=not drain, timeout=60)
     latencies.sort()
     return {
         "metric": "online_arrivals",
-        "n_jobs": N_ONLINE,
+        "n_jobs": n_jobs,
         "accepted": len(accepted),
         "shed": shed,
-        "shed_rate": round(shed / N_ONLINE, 4),
+        "shed_rate": round(shed / n_jobs, 4),
         "admission_p50_s": round(_percentile(latencies, 0.50), 6),
         "admission_p99_s": round(_percentile(latencies, 0.99), 6),
         "makespan_s": round(makespan, 3),
-        "base_rate_hz": BASE_RATE_HZ,
-        "burst_rate_hz": BURST_RATE_HZ,
-        "gateway_window": GATEWAY_WINDOW,
-        "seed": SEED,
+        "base_rate_hz": base_rate_hz,
+        "burst_rate_hz": burst_rate_hz,
+        "gateway_window": window,
+        "seed": seed,
         "status": "ok",
     }
 

@@ -1,0 +1,193 @@
+"""Anytime solver scaling: deadline-bounded re-solves at 500-10k queued jobs.
+
+Two phases, one row (validated against ``bench_guard.SOLVER_ROW_REQUIRED``):
+
+1. **Depth phase** — jobs stream through the real network gateway into a
+   running ``SaturnService`` (``online_arrivals.run_gateway_phase`` with the
+   solver-depth shape: ``window = n_jobs`` so nothing is shed — queue depth
+   is the point — and ``drain=False``: jobs are long on purpose, the run
+   reaches full depth, records a settle window of re-solves, and stops
+   without waiting out a multi-hour makespan). Every interval re-solve goes
+   through ``solver/anytime.py``; its ``solver_tier`` events give the
+   per-tier wall-time distribution and the deadline-miss count (**must be
+   zero** — the row fails self-validation otherwise).
+
+2. **Quality phase** — subsampled instances small enough for the exact
+   MILP (<= ``QUALITY_INSTANCE_N`` tasks, under ``milp_task_limit``):
+   ``anytime_solve`` under the depth phase's deadline vs ``milp.solve``
+   with a generous budget. ``quality_delta_pct`` is the mean makespan
+   excess; the row schema caps it at 10%.
+
+Run: ``python benchmarks/solver_scaling.py`` (quick mode: 500 jobs,
+CPU-safe, < 60 s — the ``solver``-marked smoke test runs this) or
+``--full`` for the 5k and 10k sweep the acceptance bar quotes. One JSON
+row per scale point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import bench_guard
+import online_arrivals
+from online_arrivals import FakeDev, _percentile, run_gateway_phase
+
+from saturn_tpu import library as lib
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.solver import anytime, milp
+from saturn_tpu.utils.metrics import read_events
+
+SEED = 11
+QUICK_JOBS = 500
+FULL_JOBS = (5000, 10000)
+INTERVAL_S = 1.0          # service interval; deadline = interval/2 = 0.5 s
+DEEP_INTERVAL_S = 2.0     # >5k queued jobs: the interval budget scales with
+                          # depth (a 10k-deep queue re-planned every second
+                          # buys nothing — jobs run for hours)
+ARRIVAL_HZ = 400.0        # jobs arrive far faster than they finish...
+LONG_BATCHES = 2000       # ...and are long, so the queue reaches full depth
+SETTLE_S = 4.0            # extra intervals of re-solves at full depth
+QUALITY_SAMPLES = 6       # subsampled exact-vs-anytime instances
+QUALITY_INSTANCE_N = 8    # small enough for the exact MILP to finish
+QUALITY_EXACT_S = 5.0     # exact-MILP budget; its incumbent is the reference
+
+
+class _QTask:
+    """Solver-facing duck type for the quality phase (numbers only)."""
+
+    def __init__(self, name, runtimes):
+        self.name = name
+        self.strategies = {
+            g: Strategy(object(), g, {}, rt, 0.1)
+            for g, rt in runtimes.items()
+        }
+
+    def feasible_strategies(self):
+        return self.strategies
+
+
+def quality_delta_pct(deadline: float, seed: int) -> float:
+    """Mean makespan excess of the anytime ladder over the exact MILP on
+    random instances the exact solver can actually finish."""
+    rng = random.Random(seed)
+    topo = SliceTopology([FakeDev() for _ in range(8)])
+    deltas = []
+    for k in range(QUALITY_SAMPLES):
+        tasks = []
+        for i in range(QUALITY_INSTANCE_N):
+            base = rng.uniform(2.0, 40.0)
+            tasks.append(_QTask(f"q{k}-{i}", {
+                2: base,
+                4: base * rng.uniform(0.55, 0.8),
+                8: base * rng.uniform(0.35, 0.6),
+            }))
+        exact = milp.solve(tasks, topo, time_limit=QUALITY_EXACT_S)
+        approx, _ = anytime.anytime_solve(tasks, topo, deadline, seed=seed + k)
+        if exact.makespan > 1e-9:
+            deltas.append(
+                100.0 * (approx.makespan - exact.makespan) / exact.makespan)
+    return max(0.0, sum(deltas) / max(len(deltas), 1))
+
+
+def run_scale_point(n_jobs: int, mode: str) -> dict:
+    topo = SliceTopology([FakeDev() for _ in range(8)])
+    mpath = tempfile.mktemp(suffix=".jsonl", prefix="solver_scaling_")
+    interval = DEEP_INTERVAL_S if n_jobs > 5000 else INTERVAL_S
+    try:
+        gw_row = run_gateway_phase(
+            topo,
+            n_jobs=n_jobs,
+            window=n_jobs,            # queue depth, not shedding, is measured
+            session_window=n_jobs,
+            base_rate_hz=ARRIVAL_HZ,
+            burst_rate_hz=ARRIVAL_HZ * 1.5,
+            interval=interval,
+            batches=LONG_BATCHES,
+            metrics_path=mpath,
+            drain=False,
+            settle_s=SETTLE_S,
+            seed=SEED,
+        )
+        events = read_events(mpath, kind="solver_tier")
+    finally:
+        if os.path.exists(mpath):
+            os.unlink(mpath)
+    if gw_row["shed"]:
+        raise SystemExit(
+            f"{gw_row['shed']} job(s) shed with window == n_jobs — the "
+            "depth phase lost arrivals and the row would under-measure")
+    if not events:
+        raise SystemExit("no solver_tier events: the anytime front-end is "
+                         "not wired into the service re-solve")
+    walls = sorted(float(e["wall_s"]) for e in events)
+    deadline = float(events[-1]["deadline_s"])
+    misses = sum(1 for e in events
+                 if float(e["wall_s"]) > float(e["deadline_s"]))
+    tier_counts: dict = {}
+    for e in events:
+        name = e.get("tier_name", str(e.get("tier")))
+        tier_counts[name] = tier_counts.get(name, 0) + 1
+    row = {
+        "metric": "solver_scaling",
+        "mode": mode,
+        "n_jobs": n_jobs,
+        "deadline_s": round(deadline, 6),
+        "resolves": len(events),
+        "deadline_misses": misses,
+        "tier_counts": tier_counts,
+        "solve_p50_s": round(_percentile(walls, 0.50), 6),
+        "solve_p99_s": round(_percentile(walls, 0.99), 6),
+        "admission_p50_s": gw_row["admission_p50_s"],
+        "admission_p99_s": gw_row["admission_p99_s"],
+        "quality_delta_pct": round(quality_delta_pct(deadline, SEED), 3),
+        "quality_samples": QUALITY_SAMPLES,
+        "seed": SEED,
+        "status": "ok",
+    }
+    problems = bench_guard.validate_solver_row(row)
+    if problems:
+        row["status"] = "invalid"
+        print(json.dumps(row))
+        raise SystemExit(f"solver row failed self-validation: {problems}")
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--full", action="store_true",
+                    help="run the 5k and 10k sweep (quick: 500 jobs)")
+    ap.add_argument("--jobs", type=int, default=0,
+                    help="override the scale point (single run)")
+    args = ap.parse_args()
+
+    lib.register("bench-online", online_arrivals.BenchTech)
+    if args.jobs:
+        points, mode = [args.jobs], "custom"
+    elif args.full:
+        points, mode = list(FULL_JOBS), "full"
+    else:
+        points, mode = [QUICK_JOBS], "quick"
+    for n in points:
+        t0 = time.monotonic()
+        row = run_scale_point(n, mode)
+        row["bench_wall_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,10 @@ Subcommands:
   under a lock, condition-wait-without-loop (SAT-C001..C004).  With no
   paths it audits the five thread-bearing packages (executor, service,
   durability, data, health) plus utils/metrics.py.
+- ``solver METRICS.jsonl``: summarize the anytime tier ladder's
+  ``solver_tier`` events from a metrics stream — per-tier adoption counts,
+  wall-time p50/p99 vs deadline, deadline misses (must be zero in a
+  healthy run), fallback (greedy) frequency, and mean quality ratio.
 
 Exit code 0 = no error-severity diagnostics; 1 = at least one error;
 2 = usage/IO failure.  ``--json`` prints the machine-readable report.
@@ -271,6 +275,92 @@ def _cmd_concurrency(args: argparse.Namespace) -> int:
     return _emit(report, False)
 
 
+def _percentile(values, q: float) -> float:
+    xs = sorted(values)
+    if not xs:
+        return 0.0
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def _cmd_solver(args: argparse.Namespace) -> int:
+    from saturn_tpu.solver.anytime import TIER_NAMES
+    from saturn_tpu.utils import metrics
+
+    try:
+        events = metrics.read_events(args.path, kind="solver_tier")
+    except OSError as e:
+        print(f"cannot read metrics at {args.path!r}: {e}", file=sys.stderr)
+        return 2
+
+    per_tier: dict = {}
+    misses = []
+    qualities = []
+    outcomes = {"fresh": 0, "slid": 0}
+    sources: dict = {}
+    for ev in events:
+        tier = ev.get("tier")
+        per_tier.setdefault(tier, []).append(float(ev.get("wall_s", 0.0)))
+        if float(ev.get("wall_s", 0.0)) > float(ev.get("deadline_s", 0.0)):
+            misses.append(ev)
+        if ev.get("quality") is not None:
+            qualities.append(float(ev["quality"]))
+        outcomes[ev.get("outcome", "fresh")] = (
+            outcomes.get(ev.get("outcome", "fresh"), 0) + 1)
+        src = ev.get("source", "?")
+        sources[src] = sources.get(src, 0) + 1
+
+    n = len(events)
+    tiers_payload = {}
+    for tier in sorted(per_tier, key=lambda t: (t is None, t)):
+        walls = per_tier[tier]
+        tiers_payload[str(tier)] = {
+            "name": TIER_NAMES.get(tier, str(tier)),
+            "count": len(walls),
+            "share": round(len(walls) / n, 4) if n else 0.0,
+            "wall_p50_s": round(_percentile(walls, 0.50), 6),
+            "wall_p99_s": round(_percentile(walls, 0.99), 6),
+        }
+    payload = {
+        "resolves": n,
+        "tiers": tiers_payload,
+        "deadline_misses": len(misses),
+        "greedy_fallbacks": len(per_tier.get(3, [])),
+        "mean_quality": (round(sum(qualities) / len(qualities), 4)
+                         if qualities else None),
+        "outcomes": outcomes,
+        "sources": sources,
+    }
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+        return 1 if misses else 0
+    if not n:
+        print(f"{args.path}: no solver_tier events")
+        return 0
+    print(f"{args.path}: {n} anytime re-solve(s) "
+          f"({outcomes.get('fresh', 0)} fresh, {outcomes.get('slid', 0)} slid)")
+    for tier, row in tiers_payload.items():
+        print(f"  tier {tier} ({row['name']}): {row['count']} "
+              f"({100 * row['share']:.1f}%), wall p50 {row['wall_p50_s']:.4f}s "
+              f"p99 {row['wall_p99_s']:.4f}s")
+    if payload["mean_quality"] is not None:
+        print(f"mean quality (makespan / lower bound): "
+              f"{payload['mean_quality']:.4f}")
+    print("sources: " + ", ".join(
+        f"{s}x{c}" for s, c in sorted(sources.items())))
+    if misses:
+        print(f"DEADLINE MISSES: {len(misses)} re-solve(s) ran past their "
+              "budget — the ladder's cost model is miscalibrated for this "
+              "host")
+        for ev in misses[:5]:
+            print(f"  tier {ev.get('tier')} wall {ev.get('wall_s')}s "
+                  f"> deadline {ev.get('deadline_s')}s "
+                  f"(n_tasks={ev.get('n_tasks')}, source={ev.get('source')})")
+        return 1
+    print("deadline misses: 0")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m saturn_tpu.analysis",
@@ -323,6 +413,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="files/directories to analyze (default: the "
                         "audited thread-mesh packages)")
     c.set_defaults(fn=_cmd_concurrency)
+
+    s = sub.add_parser(
+        "solver",
+        help="summarize anytime tier-ladder solver_tier events from a "
+             "metrics JSONL (tier shares, wall p50/p99, deadline misses)",
+    )
+    s.add_argument("path")
+    s.set_defaults(fn=_cmd_solver)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -22,7 +22,7 @@ from typing import List, Optional
 from saturn_tpu import analysis
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.executor import engine
-from saturn_tpu.solver import milp
+from saturn_tpu.solver import anytime, milp
 from saturn_tpu.utils import metrics, trace
 
 logger = logging.getLogger("saturn_tpu")
@@ -491,7 +491,14 @@ def _orchestrate_loop(
         # is not deterministic across processes); every rank executes the
         # same broadcast plan. Single-host: unchanged.
         if not multihost or distributed.is_coordinator():
-            plan = milp.solve(task_list, topo, time_limit=tlimit)  # initial blocking solve
+            # Initial blocking solve through the anytime tier ladder: a
+            # small batch degenerates to the exact MILP (single-partition
+            # tier 1); a big queue lands inside tlimit via the cheaper
+            # tiers instead of blowing the first interval.
+            plan = anytime.anytime_resolve(
+                task_list, topo, None, interval, deadline=tlimit,
+                source="orchestrator-initial",
+            )
         else:
             plan = None
         if multihost:
@@ -571,12 +578,13 @@ def _orchestrate_loop(
                     # overlap next-interval solve with this interval's execution
                     # (``orchestrator.py:69-71``)
                     future = pool.submit(
-                        milp.resolve, remaining, topo, plan, interval,
-                        threshold, tlimit,
+                        anytime.anytime_resolve, remaining, topo, plan,
+                        interval, threshold, deadline=tlimit,
                         coschedule_exclude=(
                             guardian.detached_names() if guardian is not None
                             else None
                         ),
+                        source="orchestrator",
                     )
 
                 # Snapshot the EXECUTED plan's assignments before the

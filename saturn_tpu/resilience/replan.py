@@ -42,7 +42,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.core.strategy import Strategy
-from saturn_tpu.solver import milp
+from saturn_tpu.solver import anytime, milp
 from saturn_tpu.utils import metrics
 
 log = logging.getLogger("saturn_tpu")
@@ -242,7 +242,14 @@ class ElasticReplanner:
         elif self.policy == "degrade-in-place":
             plan = self._degrade_in_place(keep, topo, previous_plan)
         else:
-            plan = milp.solve(keep, topo, time_limit=time_limit, warm=previous_plan)
+            # Speculative re-solve through the anytime tier ladder.  The old plan
+            # may reference dead devices, so it seeds the ladder (``warm``) but is
+            # never kept via compare-and-swap (``previous=None``).
+            dl = anytime.resolve_deadline(time_limit)
+            plan = anytime.anytime_resolve(
+                keep, topo, None, dl * 2.0,
+                deadline=dl, warm=previous_plan, source="replan",
+            )
 
         migrations = (
             plan.migrations_from(previous_plan) if previous_plan is not None else {}

@@ -44,7 +44,7 @@ from saturn_tpu.service.admission import (
     compute_weight,
 )
 from saturn_tpu.service.queue import JobRecord, JobState, SubmissionQueue
-from saturn_tpu.solver import milp
+from saturn_tpu.solver import anytime, milp
 from saturn_tpu.utils import metrics
 
 logger = logging.getLogger("saturn_tpu")
@@ -478,11 +478,18 @@ class SaturnService:
                     r.name: self._weight(r) for r in jobs.values()
                 }
                 t_solve = timeit.default_timer()
-                candidate = milp.resolve(
+                # Anytime tier ladder (solver/anytime.py): the re-solve
+                # always lands inside the deadline derived from the interval
+                # budget (tlimit = solver_time_limit, default interval/2;
+                # SATURN_TPU_SOLVE_DEADLINE overrides), falling down the
+                # incremental -> partition -> LP-rounding -> greedy tiers
+                # when the queue outgrows the exact MILP.
+                candidate = anytime.anytime_resolve(
                     tasks, topo, plan, self.interval, self.threshold,
-                    tlimit, weights=weights,
+                    deadline=tlimit, weights=weights,
                     coschedule_exclude=(guardian.detached_names()
                                         if guardian is not None else None),
+                    source="service",
                 )
                 # Mandatory adoption gate (service re-solve path): a
                 # candidate the static verifier rejects is quarantined and
@@ -754,7 +761,12 @@ class SaturnService:
         limit = min(r.deadline_at for r in with_deadline) - time.monotonic()
         limit = max(limit, 1e-3)
         tasks = [r.task for r in jobs.values()]
-        proj = milp.greedy_plan(tasks, topo).makespan
+        # Pessimistic greedy projection; the frontier variant keeps this
+        # O(N * capacity) once the live set outgrows backfill scheduling.
+        if len(tasks) > 300:
+            proj = anytime.fast_greedy_plan(tasks, topo).makespan
+        else:
+            proj = milp.greedy_plan(tasks, topo).makespan
         if proj <= limit:
             return
         from saturn_tpu.resilience.replan import ReplanContext, get_policy

@@ -195,6 +195,7 @@ def warm_schedule(
     ordering_slack: float = 1.0,
     insert_missing: bool = False,
     weights: Optional[Dict[str, float]] = None,
+    insertion_probe_cap: Optional[int] = None,
 ) -> Optional[Plan]:
     """Fix-and-optimize warm start: keep each task's previous (size, block)
     choice, list-schedule starts under CURRENT runtimes in previous start
@@ -209,6 +210,14 @@ def warm_schedule(
     ties broken longest-first). This is the online service's incremental
     warm start: one arrival or departure perturbs the live plan instead of
     invalidating it.
+
+    ``insertion_probe_cap`` bounds the (strategy, block) slots probed per
+    inserted task: probes run in the deterministic sorted option order and
+    stop at the cap once at least one feasible slot was found (the cap never
+    leaves a schedulable task unplaced — it only stops the search for a
+    *better* slot). The anytime solver's tier-0 budget depends on this: one
+    newcomer with a rich option set on a big mesh must cost O(cap) probes,
+    not O(sizes x blocks).
     """
     pinned: List[Tuple[object, int, Block, float]] = []  # (task, size, blk, rt)
     loose: List = []
@@ -242,14 +251,24 @@ def warm_schedule(
     )
     for t in loose:
         best = None  # (finish, start, size, blk, rt)
+        probes = 0
         for size, strat in sorted(t.feasible_strategies().items()):
             if size > topology.capacity:
                 continue
             for blk in topology.blocks(size):
+                if (insertion_probe_cap is not None
+                        and probes >= insertion_probe_cap
+                        and best is not None):
+                    break  # deterministic cutoff: keep the best slot so far
+                probes += 1
                 st = timeline.earliest_free(blk, strat.runtime + ordering_slack)
                 fin = st + strat.runtime
                 if best is None or fin < best[0]:
                     best = (fin, st, size, blk, strat.runtime)
+            if (insertion_probe_cap is not None
+                    and probes >= insertion_probe_cap
+                    and best is not None):
+                break
         if best is None:
             return None  # a loose task fits no block: no warm plan exists
         fin, st, size, blk, rt = best
