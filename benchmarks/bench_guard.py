@@ -102,6 +102,26 @@ def bench_plan_errors(new: dict) -> list:
     return [d.to_json() for d in report.errors]
 
 
+def bench_shardflow_errors() -> list:
+    """Unsanctioned SAT-X findings over the technique + kernel sources
+    (saturn-shardflow).
+
+    The headline number is produced by a technique's step function; a row
+    measured while that code carries an unsanctioned sharding funnel
+    (SAT-X002 gather-to-replicated and friends) bakes the defect into the
+    baseline every later round is compared against. AST-only — same
+    any-environment rule as the ``tools/lint.py`` gate.  Returns error
+    diagnostics (JSON form); sanctioned findings are info and pass.
+    """
+    sys.path.insert(0, REPO)
+    from saturn_tpu.analysis.diagnostics import AnalysisReport
+    from saturn_tpu.analysis.shardflow import passes as sf_passes
+
+    report = AnalysisReport(subject="bench_guard-shardflow")
+    sf_passes.scan_sources(sf_passes.default_source_paths(REPO), report)
+    return [d.to_json() for d in report.errors]
+
+
 #: Required key -> type for one ``benchmarks/chaos_campaign.py`` output row.
 #: The campaign bench self-validates against this before printing, and CI
 #: can re-check recorded rows — a schema drift (renamed key, stringified
@@ -340,6 +360,20 @@ def main() -> int:
         print(json.dumps({
             "metric": "bench_guard", "status": "plan_verification_failed",
             "value": new.get("value"), "diagnostics": plan_errors,
+        }))
+        return 1
+    try:
+        sf_errors = bench_shardflow_errors()
+    except Exception as e:
+        sf_errors = [{"code": "SAT-X000", "severity": "error",
+                      "message": f"shardflow pass unavailable: "
+                                 f"{type(e).__name__}: {e}"}]
+    if sf_errors:
+        # Same refusal for the sharding pass: the row was measured by a
+        # technique whose source carries an unsanctioned SAT-X funnel.
+        print(json.dumps({
+            "metric": "bench_guard", "status": "shardflow_findings",
+            "value": new.get("value"), "diagnostics": sf_errors,
         }))
         return 1
     out = {

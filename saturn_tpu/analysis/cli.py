@@ -27,6 +27,13 @@ Subcommands:
   ``solver_tier`` events from a metrics stream — per-tier adoption counts,
   wall-time p50/p99 vs deadline, deadline misses (must be zero in a
   healthy run), fallback (greedy) frequency, and mean quality ratio.
+- ``shardflow``: saturn-shardflow's jaxpr-level sharding-propagation pass
+  over every in-tree technique — traces each step function on virtual CPU
+  devices (no chip), propagates PartitionSpecs through every equation, and
+  reports the communication ledger plus SAT-X001..X005 findings, with the
+  source scan (SAT-X002) over ``parallel/``, ``ops/`` and
+  ``utils/checkpoint.py``.  ``--size`` sets the probe sub-mesh size,
+  ``--ledger`` prints per-technique collective byte totals.
 
 Exit code 0 = no error-severity diagnostics; 1 = at least one error;
 2 = usage/IO failure.  ``--json`` prints the machine-readable report.
@@ -275,6 +282,48 @@ def _cmd_concurrency(args: argparse.Namespace) -> int:
     return _emit(report, False)
 
 
+def _cmd_shardflow(args: argparse.Namespace) -> int:
+    import os
+
+    # The audit traces techniques at a probe sub-mesh size on virtual CPU
+    # devices — no chip, no compile. Outside the test harness this process
+    # sees one CPU device, so the device-count flag must land before jax
+    # initializes; once jax is imported the platform is frozen.
+    if "jax" not in sys.modules:
+        want = args.size * 2
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={want}"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from saturn_tpu.analysis.shardflow import passes as sf_passes
+
+    try:
+        report, ledgers = sf_passes.audit_intree(size=args.size)
+    except (OSError, ImportError, RuntimeError) as e:
+        print(f"shardflow audit failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        payload = report.to_json()
+        payload["ledgers"] = {
+            name: led.to_json() for name, led in sorted(ledgers.items())
+        }
+        print(json.dumps(payload, sort_keys=True, default=str))
+        return 0 if report.ok else 1
+    rc = _emit(report, False)
+    if args.ledger:
+        for name, led in sorted(ledgers.items()):
+            ops = ", ".join(
+                f"{op} x{row['count']} ({row['bytes']}B)"
+                for op, row in sorted(led.by_op().items())
+            ) or "no collectives"
+            print(f"  {name}: {ops}; flops {led.flops:.3g}")
+    return rc
+
+
 def _percentile(values, q: float) -> float:
     xs = sorted(values)
     if not xs:
@@ -421,6 +470,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     s.add_argument("path")
     s.set_defaults(fn=_cmd_solver)
+
+    x = sub.add_parser(
+        "shardflow",
+        help="saturn-shardflow: trace every in-tree technique's step "
+             "function, propagate its PartitionSpecs, and report the "
+             "communication ledger + SAT-X findings",
+    )
+    x.add_argument("--size", type=int, default=4,
+                   help="probe sub-mesh size (default 4)")
+    x.add_argument("--ledger", action="store_true",
+                   help="also print per-technique collective byte totals")
+    x.set_defaults(fn=_cmd_shardflow)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -79,6 +79,31 @@ A ``# sanctioned-unlocked: <reason>`` comment on the finding line, in the
 contiguous comment block above it, or above the enclosing ``def`` (which
 sanctions the whole function) downgrades a SAT-C finding to ``info`` —
 audited cases stay visible but do not gate.
+
+Sharding-propagation pass (``SAT-X*``) — ``analysis.shardflow``
+(saturn-shardflow):
+
+========== ========= ===========================================================
+SAT-X000   error     technique/source untraceable or unparseable (warning when
+                     a single technique fails to trace; error for source parse)
+SAT-X001   error     implicit reshard: an equation's operands disagree on the
+                     mesh axes of a shared dimension inside the fused hot loop
+SAT-X002   error     gather-to-replicated / single-writer funnel: a full-tensor
+                     ``process_allgather`` or device_put-to-replicated in
+                     source (the ``utils/checkpoint.py`` pattern)
+SAT-X003   warning   fully-replicated intermediate above the size threshold
+                     (default 64 MiB) — per-chip HBM spent on identical bytes
+SAT-X004   error     cross-slice collective inside an inner ``scan``: a
+                     DCN-crossing mesh axis appears in a collective at scan
+                     depth >= 1, multiplying DCN latency by the trip count
+SAT-X005   warning   static communication estimate vs. profiled runtime
+                     disagreement above 35% — the cold-start prior is
+                     miscalibrated for this workload
+========== ========= ===========================================================
+
+A ``# sanctioned-shardflow: <reason>`` comment on the finding line or in
+the contiguous comment block above it downgrades a SAT-X finding to
+``info`` — sanctions explain, they never silence.
 """
 
 from __future__ import annotations
@@ -91,8 +116,9 @@ from typing import Any, Dict, List, Optional, Tuple
 #: check is added/removed or a code changes meaning. Mixed into the profile
 #: and AOT cache fingerprints (``utils/profile_cache.py``,
 #: ``utils/aot_cache.py``) so a plan repaired under one rule set never reads
-#: back cache entries recorded under another.
-SCHEMA_VERSION = 2
+#: back cache entries recorded under another. 2 -> 3: saturn-shardflow
+#: (SAT-X sharding-propagation pass + cold-start prior).
+SCHEMA_VERSION = 3
 
 #: severity levels, weakest to strongest
 SEVERITIES = ("info", "warning", "error")

@@ -1,0 +1,33 @@
+"""saturn-shardflow: jaxpr-level sharding propagation + comm-cost analysis.
+
+Three passes over a technique's traced step function (abstract values
+only — CPU, no chip):
+
+- :mod:`.interp` — propagate the technique's PartitionSpecs through every
+  jaxpr equation into a per-collective communication ledger (op, mesh
+  axes, bytes = elements x dtype x axis factor);
+- :mod:`.passes` — SAT-X diagnostics with file:line-ish jaxpr provenance
+  (SAT-X001 implicit reshard, SAT-X002 gather-to-replicated source scan,
+  SAT-X003 oversized replicated intermediate, SAT-X004 cross-slice
+  collective inside a scan), sanctionable via
+  ``# sanctioned-shardflow: reason`` markers (downgrade to info, never
+  silence);
+- :mod:`.prior` — the cold-start solver prior: the byte ledger priced by
+  a roofline hardware model into ``static_prior=True`` strategies that
+  make ADMIT/DEFER and first plans sharding-aware before the trial
+  runner has run, with SAT-X005 auditing the estimate once real
+  measurements supersede it.
+
+Import-light at package level (the CLI must be able to set XLA device
+flags before jax loads); everything heavier is imported inside functions.
+"""
+
+from __future__ import annotations
+
+#: Version of the shardflow rule set (propagation rules, ledger schema,
+#: prior cost model). Folded into the profile-cache fingerprint and the
+#: AOT-cache runtime identity so profiles and executables recorded under
+#: one rule set miss cleanly under another.
+PASS_VERSION = 1
+
+__all__ = ["PASS_VERSION"]

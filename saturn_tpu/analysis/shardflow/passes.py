@@ -1,0 +1,326 @@
+"""Shardflow pass 2: SAT-X diagnostics over ledgers and source.
+
+Two complementary detectors feed one :class:`AnalysisReport`:
+
+- **ledger diagnostics** over an interpreted step trace
+  (:func:`analyze_traced`): SAT-X001 implicit reshard on the fused hot
+  loop, SAT-X003 fully-replicated intermediate above the size threshold,
+  SAT-X004 cross-slice collective inside a ``scan`` body;
+- **source diagnostics** (:func:`scan_sources`): SAT-X002
+  gather-to-replicated / single-writer patterns — ``process_allgather``
+  calls and ``device_put`` to a literal replicated ``NamedSharding`` —
+  found by AST walk, the ``utils/checkpoint.py`` wall ROADMAP item 6
+  names.
+
+A ``# sanctioned-shardflow: <reason>`` comment on the finding line or in
+the contiguous comment block above it downgrades the finding to ``info``
+— audited cases stay visible but never gate (the saturn-tsan marker
+convention, never silence).
+
+SAT-X005 (static-estimate vs profiled-runtime disagreement) lives in
+:mod:`saturn_tpu.analysis.shardflow.prior` next to the estimate it
+audits.
+"""
+
+from __future__ import annotations
+
+import ast
+import logging
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from saturn_tpu.analysis.diagnostics import AnalysisReport, make
+
+from saturn_tpu.analysis.shardflow.interp import CommLedger, interpret
+
+log = logging.getLogger("saturn_tpu")
+
+SANCTION_MARKER = "sanctioned-shardflow:"
+
+#: SAT-X003 default byte floor for flagging a fully-replicated intermediate.
+REPLICATED_THRESHOLD = 1 << 26
+
+
+def _sanction_in_lines(lines: Sequence[str], line: int) -> Optional[str]:
+    """Marker text on ``line`` (1-indexed) or in the contiguous comment
+    block immediately above it — the saturn-tsan lookup, re-implemented
+    over a plain line list so source and AST findings share it."""
+    if 1 <= line <= len(lines):
+        text = lines[line - 1]
+        if SANCTION_MARKER in text:
+            return text.split(SANCTION_MARKER, 1)[1].strip() or "audited"
+    ln = line - 1
+    while 1 <= ln <= len(lines):
+        text = lines[ln - 1]
+        if not text.strip().startswith("#"):
+            break
+        if SANCTION_MARKER in text:
+            return text.split(SANCTION_MARKER, 1)[1].strip() or "audited"
+        ln -= 1
+    return None
+
+
+def _sanction_at(provenance: str) -> Optional[str]:
+    """Resolve a ``file:line`` provenance against its source file's
+    sanction markers; eqn#-style provenance can never be sanctioned."""
+    path, _, line_s = provenance.rpartition(":")
+    try:
+        line = int(line_s)
+    except ValueError:
+        return None
+    if not path or not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    return _sanction_in_lines(lines, line)
+
+
+def crossing_axes(mesh_axes: Dict[str, int],
+                  slice_size: Optional[int]) -> frozenset:
+    """Mesh axes whose collectives ride DCN rather than ICI.
+
+    Devices are slice-major (``core/mesh.py``): an aligned block of at most
+    one slice never crosses a boundary, and when a block spans slices it is
+    the *leading* mesh axis that crosses. So: no axis crosses when the
+    total device count fits one slice; otherwise the leading axis does.
+    """
+    if not slice_size:
+        return frozenset()
+    total = 1
+    for n in mesh_axes.values():
+        total *= int(n)
+    if total <= slice_size:
+        return frozenset()
+    leading = next(iter(mesh_axes), None)
+    return frozenset({leading} if leading else ())
+
+
+# --------------------------------------------------------------- ledger pass
+def analyze_traced(
+    traced: Dict[str, Any],
+    report: Optional[AnalysisReport] = None,
+    slice_size: Optional[int] = None,
+    replicated_threshold: int = REPLICATED_THRESHOLD,
+) -> Tuple[AnalysisReport, CommLedger]:
+    """SAT-X001/X003/X004 over one ``trace_step`` result."""
+    subject = f"shardflow:{traced.get('technique')}@{traced.get('size')}"
+    if report is None:
+        report = AnalysisReport(subject=subject)
+    ledger = interpret(traced, replicated_threshold=replicated_threshold)
+    cross = crossing_axes(traced.get("mesh_axes", {}), slice_size)
+
+    for rec in ledger.resharded:
+        sanction = _sanction_at(rec.provenance)
+        report.add(make(
+            "SAT-X001", "info" if sanction else "error",
+            f"implicit reshard on the fused hot loop: {rec.primitive} "
+            f"mixes shardings over axes {list(rec.axes)} "
+            f"({rec.bytes} bytes x{rec.count})"
+            + (f" [sanctioned: {sanction}]" if sanction else ""),
+            counterexample=rec.to_json(),
+            location=rec.provenance, category="shardflow",
+        ))
+
+    for nbytes, provenance in ledger.replicated_intermediates:
+        sanction = _sanction_at(provenance)
+        report.add(make(
+            "SAT-X003", "info" if sanction else "warning",
+            f"fully-replicated intermediate of {nbytes} bytes "
+            f"(>= {replicated_threshold}) — every chip holds a full copy"
+            + (f" [sanctioned: {sanction}]" if sanction else ""),
+            counterexample={"bytes": nbytes},
+            location=provenance, category="shardflow",
+        ))
+
+    if cross:
+        for rec in ledger.records:
+            if rec.scan_depth >= 1 and set(rec.axes) & cross:
+                sanction = _sanction_at(rec.provenance)
+                report.add(make(
+                    "SAT-X004", "info" if sanction else "error",
+                    f"cross-slice collective inside a scan body: "
+                    f"{rec.op} over {list(rec.axes)} repeats x{rec.count} "
+                    f"per step over DCN"
+                    + (f" [sanctioned: {sanction}]" if sanction else ""),
+                    counterexample=rec.to_json(),
+                    location=rec.provenance, category="shardflow",
+                ))
+    return report, ledger
+
+
+# --------------------------------------------------------------- source pass
+def _is_replicated_namedsharding(node: ast.AST) -> bool:
+    """``NamedSharding(mesh, PartitionSpec())`` — a literal everything-to-
+    every-chip target."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "NamedSharding"
+            and len(node.args) >= 2):
+        return False
+    spec = node.args[1]
+    return (isinstance(spec, ast.Call)
+            and isinstance(spec.func, ast.Name)
+            and spec.func.id in ("PartitionSpec", "P")
+            and not spec.args and not spec.keywords)
+
+
+def scan_sources(paths: Sequence[str],
+                 report: Optional[AnalysisReport] = None) -> AnalysisReport:
+    """SAT-X002 over source files: gather-to-replicated / single-writer
+    sites (``process_allgather``, ``device_put`` to a replicated
+    ``NamedSharding``)."""
+    if report is None:
+        report = AnalysisReport(subject="shardflow:sources")
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, names in os.walk(p):
+                files += [os.path.join(root, n) for n in sorted(names)
+                          if n.endswith(".py")]
+        elif p.endswith(".py"):
+            files.append(p)
+    for path in files:
+        try:
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError) as e:
+            report.add(make(
+                "SAT-X000", "error",
+                f"source file failed to parse: {type(e).__name__}: {e}",
+                location=path, category="shardflow",
+            ))
+            continue
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            hit = None
+            if name == "process_allgather":
+                hit = ("process_allgather gathers every shard to every "
+                       "process — a single-writer wall at billion scale")
+            elif name == "device_put" and any(
+                _is_replicated_namedsharding(a) for a in node.args
+            ):
+                hit = ("device_put to a replicated NamedSharding gathers "
+                       "the full value onto every chip")
+            if hit is None:
+                continue
+            sanction = _sanction_in_lines(lines, node.lineno)
+            loc = f"{os.path.relpath(path)}:{node.lineno}"
+            report.add(make(
+                "SAT-X002", "info" if sanction else "error",
+                f"gather-to-replicated/single-writer: {hit}"
+                + (f" [sanctioned: {sanction}]" if sanction else ""),
+                location=loc, category="shardflow",
+            ))
+    return report
+
+
+def default_source_paths(repo_root: Optional[str] = None) -> List[str]:
+    """The audited packages: the technique hot paths plus the known
+    checkpoint gather wall."""
+    root = repo_root or os.getcwd()
+    candidates = [
+        os.path.join(root, "saturn_tpu", "parallel"),
+        os.path.join(root, "saturn_tpu", "ops"),
+        os.path.join(root, "saturn_tpu", "utils", "checkpoint.py"),
+    ]
+    return [p for p in candidates if os.path.exists(p)]
+
+
+# ------------------------------------------------------------ in-tree audit
+def _probe_tasks(tmpdir: str):
+    """Tiny probe tasks covering the in-tree technique families: a dense
+    causal GPT-2 (dp/fsdp/tp/ring/ulysses) and a MoE variant (ep)."""
+    from saturn_tpu.core.task import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+
+    def mk(preset: str) -> Task:
+        return Task(
+            get_model=lambda **kw: build_gpt2(preset, **kw),
+            get_dataloader=lambda: make_lm_dataset(
+                context_length=64, batch_size=8, vocab_size=256,
+                n_tokens=64 * 8 * 2,
+            ),
+            loss_fn=pretraining_loss,
+            hparams=HParams(lr=1e-3, batch_count=4),
+            save_dir=os.path.join(tmpdir, "ckpts"),
+        )
+
+    return {"dense": mk("test-tiny"), "moe": mk("moe-test-tiny")}
+
+
+def analyze_technique(
+    tech: Any, task: Any, devices: Sequence[Any],
+    config: Optional[Dict[str, Any]] = None,
+    report: Optional[AnalysisReport] = None,
+    slice_size: Optional[int] = None,
+    replicated_threshold: int = REPLICATED_THRESHOLD,
+) -> Tuple[AnalysisReport, Optional[CommLedger]]:
+    """Trace + interpret + diagnose one (technique, task, size, config)."""
+    if config is None:
+        grid = tech.candidate_configs(task, len(devices))
+        if not grid:
+            return report or AnalysisReport(
+                subject=f"shardflow:{tech.name}"), None
+        config = grid[0]
+    traced = tech.trace_step(task, devices, config)
+    return analyze_traced(traced, report=report, slice_size=slice_size,
+                          replicated_threshold=replicated_threshold)
+
+
+def audit_intree(
+    size: int = 4,
+    devices: Optional[Sequence[Any]] = None,
+    repo_root: Optional[str] = None,
+    slice_size: Optional[int] = None,
+) -> Tuple[AnalysisReport, Dict[str, CommLedger]]:
+    """The CLI/gate entry point: SAT-X over every registered in-tree
+    technique's traced step at a probe size, plus the SAT-X002 source scan
+    over the audited packages. Techniques a probe task cannot exercise
+    (no candidate configs, missing model hints) are skipped, not failed —
+    the gate is about the code that *would* run."""
+    import tempfile
+
+    import jax
+
+    from saturn_tpu.parallel import BUILTIN_TECHNIQUES
+
+    report = AnalysisReport(subject="shardflow")
+    scan_sources(default_source_paths(repo_root), report=report)
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    probe = min(size, len(devs))
+    ledgers: Dict[str, CommLedger] = {}
+    with tempfile.TemporaryDirectory() as tmpdir:
+        tasks = _probe_tasks(tmpdir)
+        for name, cls in sorted(BUILTIN_TECHNIQUES.items()):
+            tech = cls() if isinstance(cls, type) else cls
+            if not hasattr(tech, "trace_step"):
+                continue  # non-SPMD executor (pipeline): out of scope
+            task = tasks["moe" if name == "ep" else "dense"]
+            try:
+                _, ledger = analyze_technique(
+                    tech, task, devs[:probe], report=report,
+                    slice_size=slice_size,
+                )
+            except Exception as e:
+                report.add(make(
+                    "SAT-X000", "warning",
+                    f"technique {name!r} could not be traced at size "
+                    f"{probe}: {type(e).__name__}: {e}",
+                    category="shardflow",
+                ))
+                continue
+            if ledger is not None:
+                ledgers[name] = ledger
+    return report, ledgers

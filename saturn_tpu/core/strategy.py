@@ -58,6 +58,15 @@ class Strategy:
     # (``trial_runner/evaluator.py``). Cleared the first time a realized
     # interval measurement lands on this strategy (``Task.apply_realized_feedback``).
     interpolated: bool = field(default=False)
+    # Synthesized by the shardflow cold-start prior
+    # (``analysis/shardflow/prior.py``): runtime comes from the static
+    # roofline + communication-ledger model, not from any trial. Like
+    # ``interpolated``, cleared the moment real evidence lands — a trial
+    # profile replaces the strategy wholesale, and
+    # ``Task.apply_realized_feedback`` clears the flag on the first realized
+    # interval. Journaled as ``static_prior`` in admission/solver events so
+    # plans built on untested estimates are auditable (SAT-X005).
+    static_prior: bool = field(default=False)
     # Persistent profile-cache fingerprint for this (task, technique, size)
     # grid point (``utils/profile_cache.py``) — lets the orchestrator write
     # realized measurements back to the cache.

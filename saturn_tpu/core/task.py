@@ -303,6 +303,11 @@ class Task:
         # entry — the trial runner only profiled anchor sizes and
         # interpolated this one (``trial_runner/evaluator.py``).
         strat.interpolated = False
+        # Same for a shardflow cold-start prior: once the job has produced a
+        # realized interval, the static roofline estimate is superseded —
+        # SAT-X005 (``analysis/shardflow/prior.py:audit_task``) then compares
+        # the two and flags a miscalibrated prior.
+        strat.static_prior = False
         self.last_feedback_strategy = strat
         strat.runtime = strat.per_batch_time * max(self.total_batches, 0)
         trial_base = getattr(strat, "_trial_per_batch", 0.0) or 0.0

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from saturn_tpu.ops.shmap_compat import shard_map
+
 
 def balance_stages(costs: Sequence[float], n_stages: int) -> Tuple[int, ...]:
     """Contiguous layer->stage partition minimizing the max per-stage cost.
@@ -294,7 +296,7 @@ def pipeline_loss_and_grads(
 
     grad_specs = dict(param_specs)
     if active is not None:
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local_fn,
             mesh=mesh,
             in_specs=(param_specs, P(data_axis), P(stage_axis)),
@@ -305,7 +307,7 @@ def pipeline_loss_and_grads(
         grads = dict(grads)
         grads[block_key] = _unpad_stack(grads[block_key], spans, n_max)
         return loss, grads
-    mapped = jax.shard_map(
+    mapped = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, P(data_axis)),

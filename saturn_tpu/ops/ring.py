@@ -31,6 +31,8 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from saturn_tpu.ops.shmap_compat import shard_map
+
 
 def ring_attention(
     q: jax.Array,
@@ -160,7 +162,7 @@ def ring_loss_and_grads(
         return loss, grads
 
     param_specs = jax.tree.map(lambda _: P(), params)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, P(data_axis, seq_axis)),

@@ -1,0 +1,26 @@
+"""shard_map across jax versions.
+
+``jax.shard_map`` (new API, ``check_vma=``) only exists on recent jax;
+older releases ship ``jax.experimental.shard_map.shard_map`` (same
+semantics, the replication check is spelled ``check_rep=``). The ring /
+ulysses / pipeline ops and the shardflow tracer all need whichever one
+the interpreter can see, so the dispatch lives here once.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def shard_map(f, *, mesh: Any, in_specs: Any, out_specs: Any,
+              check_vma: bool = True):
+    """Version-portable ``shard_map`` (keyword-only, like the new API)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)

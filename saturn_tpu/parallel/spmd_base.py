@@ -688,6 +688,52 @@ class SPMDTechnique(BaseTechnique):
             batch_sds=batch_sds,
         )
 
+    # ------------------------------------------------------------- shardflow
+    def trace_step(
+        self, task: Any, devices: Sequence[Any], config: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """Build hook for saturn-shardflow (``analysis/shardflow/``): trace
+        this technique's train step to a closed jaxpr together with its
+        sharding intent, **without compiling** — abstract values only, so
+        the static analyzer can propagate PartitionSpecs through every
+        equation on CPU before any chip time is spent.
+
+        Mirrors ``_build_uncached`` up to (but excluding) ``jit``/``lower``:
+        same mesh, same step functions, same rule-derived specs — if the two
+        ever diverge the differential test (``tests/test_shardflow_
+        differential.py``) catches it against the compiled program.
+        """
+        spec = task.get_model(**self._model_overrides(config))
+        axis_names, axis_sizes = self.mesh_spec(len(devices), task, config)
+        mesh = make_submesh(devices, axis_names, axis_sizes)
+        mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+        ds = task.get_dataset()
+        init_state, train_step = self.make_step_fns(spec, task, config, mesh, ds)
+        state_shapes = jax.eval_shape(init_state)
+        rules = self.param_rules(task, config)
+        state_specs = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: rules(
+                shr._path_str(path), tuple(leaf.shape), mesh_axes
+            ),
+            state_shapes,
+        )
+        batch_sds = jax.ShapeDtypeStruct(
+            ds.example_batch().shape, ds.example_batch().dtype
+        )
+        closed = jax.make_jaxpr(train_step)(state_shapes, batch_sds)
+        return {
+            "jaxpr": closed,
+            "state_shapes": state_shapes,
+            "state_specs": state_specs,
+            "batch_spec": self.batch_spec(config),
+            "batch_sds": batch_sds,
+            "mesh_axes": mesh_axes,
+            "technique": self.name,
+            "size": len(devices),
+            "config": dict(config),
+        }
+
     # ------------------------------------------------------------ feasibility
     def _fits_memory(self, bundle: _Bundle, devices: Sequence[Any]) -> bool:
         """XLA compile-time memory check (replaces OOM probes,

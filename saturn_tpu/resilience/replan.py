@@ -170,6 +170,14 @@ class ElasticReplanner:
             model = lambda g: t0 * g0 / float(g)  # pure-parallel: pessimistic on shrink
         anchor_g = min(pts, key=lambda p: p[0])[0]
         anchor = feas[anchor_g]
+        # When every anchor point is itself a shardflow cold-start prior
+        # (no trial has run yet), the fit is priors-all-the-way-down: the
+        # synthesized strategy must carry ``static_prior`` too, so the
+        # solver journal doesn't launder an untested estimate into a
+        # "measured" plan.
+        all_static = all(
+            getattr(feas[g], "static_prior", False) for g, _ in pts
+        )
         added: List[int] = []
         g = capacity
         while g >= 1:
@@ -182,6 +190,7 @@ class ElasticReplanner:
                     runtime=pbt * max(task.total_batches, 0),
                     per_batch_time=pbt,
                     interpolated=True,
+                    static_prior=all_static,
                 )
                 added.append(g)
                 break  # one synthesized size (the largest fitting) is enough

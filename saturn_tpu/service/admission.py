@@ -47,6 +47,11 @@ class AdmissionDecision:
     trials_run: int = 0          # trials the profiling sweep executed
     weight: float = 0.0          # solver objective weight (ADMIT only)
     latency_s: float = 0.0       # wall-clock admission latency
+    # This decision rests on shardflow cold-start priors: the job's
+    # strategies were synthesized from the static sharding/communication
+    # analysis (``analysis/shardflow/prior.py``), not from trials. Realized
+    # feedback supersedes them; SAT-X005 audits the estimate afterwards.
+    static_prior: bool = False
 
 
 def _min_feasible_runtime(task) -> float:
@@ -76,12 +81,20 @@ class AdmissionController:
         profile_cache: Any = None,
         prune: bool = True,
         parallel_trials: Optional[int] = None,
+        static_priors: bool = False,
     ):
         self.base_capacity = topology.capacity
         self.technique_names = technique_names
         self.profile_cache = profile_cache
         self.prune = prune
         self.parallel_trials = parallel_trials
+        #: Opt-in shardflow cold-start path: a never-profiled arrival gets
+        #: ``static_prior=True`` strategies from the jaxpr-level sharding /
+        #: communication analysis instead of paying the trial sweep up
+        #: front. ADMIT/DEFER become sharding-aware with zero chip time;
+        #: the first realized interval supersedes the prior and SAT-X005
+        #: audits it (``_audit_priors``).
+        self.static_priors = static_priors
         self.queue = queue
         #: Optional write-ahead journal (set by ``SaturnService`` when
         #: durability is on): every admission outcome becomes a buffered
@@ -100,6 +113,11 @@ class AdmissionController:
         task = rec.task
 
         trials = 0
+        used_prior = False
+        if self.static_priors and not task.feasible_strategies():
+            # Shardflow cold-start path: synthesize static-prior strategies
+            # from the jaxpr-level analysis — zero trials, zero compiles.
+            used_prior = self._synthesize_priors(rec, task, topology)
         if not task.feasible_strategies():
             # Cold (or never-seen) arrival: run the sweep. Warm fingerprints
             # resolve entirely from the profile cache — zero trials.
@@ -123,6 +141,11 @@ class AdmissionController:
                 return dec
             trials = int((stats or {}).get("trials_run", 0))
         rec.trials_run += trials
+        if self.static_priors:
+            # SAT-X005: any strategy whose prior has since been superseded
+            # by real evidence gets its static estimate audited now, while
+            # the job is back in front of the controller.
+            self._audit_priors(rec, task)
 
         fits = any(
             g <= topology.capacity for g in task.feasible_strategies()
@@ -157,6 +180,7 @@ class AdmissionController:
                 ),
                 trials_run=trials,
                 latency_s=timeit.default_timer() - t0,
+                static_prior=used_prior,
             )
             self._note(rec, dec)
             return dec
@@ -179,11 +203,52 @@ class AdmissionController:
             if rec.request.deadline_s is not None:
                 hints["deadline"] = float(rec.request.deadline_s)
         dec = AdmissionDecision(
-            ADMIT, reason="ok", trials_run=trials, weight=weight,
+            ADMIT, reason="static prior" if used_prior else "ok",
+            trials_run=trials, weight=weight,
             latency_s=timeit.default_timer() - t0,
+            static_prior=used_prior,
         )
         self._note(rec, dec)
         return dec
+
+    # ------------------------------------------------------------ shardflow
+    def _synthesize_priors(self, rec: JobRecord, task,
+                           topology: SliceTopology) -> bool:
+        """Fill the task's grid with static-prior strategies; never raises
+        (an untraceable task just falls through to the trial sweep)."""
+        try:
+            from saturn_tpu.analysis.shardflow import prior as sf_prior
+
+            added = sf_prior.synthesize_strategies(
+                task, topology, technique_names=self.technique_names,
+            )
+        except Exception as e:
+            logger.warning(
+                "admission: shardflow prior failed for %s (%r); falling "
+                "back to the trial sweep", rec.job_id, e,
+            )
+            return False
+        if added:
+            logger.info(
+                "admission: %s admitted on shardflow static priors at "
+                "sizes %s (no trials)", rec.job_id, added,
+            )
+        return bool(added)
+
+    def _audit_priors(self, rec: JobRecord, task) -> None:
+        """Emit SAT-X005 for superseded priors (warn-only, never gates)."""
+        try:
+            from saturn_tpu.analysis.shardflow import prior as sf_prior
+
+            diags = sf_prior.audit_task(task)
+        except Exception:
+            return
+        for d in diags:
+            logger.warning("admission: %s %s", rec.job_id, d.message)
+            metrics.event(
+                "shardflow_audit", job=rec.job_id, task=rec.name,
+                **d.to_json(),
+            )
 
     def _note(self, rec: JobRecord, dec: AdmissionDecision) -> None:
         if self.journal is not None:
@@ -191,12 +256,14 @@ class AdmissionController:
                 "job_admission", job=rec.job_id, task=rec.name,
                 decision=dec.action, reason=dec.reason,
                 trials_run=dec.trials_run, weight=round(dec.weight, 6),
+                static_prior=dec.static_prior,
             )
         metrics.event(
             "job_admitted", job=rec.job_id, task=rec.name,
             decision=dec.action, reason=dec.reason,
             trials_run=dec.trials_run, warm=dec.trials_run == 0,
             weight=round(dec.weight, 6), latency_s=round(dec.latency_s, 6),
+            static_prior=dec.static_prior,
         )
         logger.info(
             "admission: %s %s (%s; %d trials, weight %.3f, %.3fs)",

@@ -102,6 +102,10 @@ class AnytimeReport:
     quality: Optional[float]  # makespan / lower_bound (>= 1.0; None if no lb)
     tiers_tried: List[int] = field(default_factory=list)
     outcome: str = "fresh"    # "fresh" or "slid" (compare-and-swap kept old)
+    # How many of the adopted plan's assignments rest on shardflow
+    # cold-start priors (``Strategy.static_prior``) rather than trials —
+    # the "this plan is partly an educated guess" signal in solver_tier.
+    n_static_prior: int = 0
 
     @property
     def tier_name(self) -> str:
@@ -691,11 +695,20 @@ def anytime_solve(task_list: Sequence, topology: SliceTopology,
 
     lb = max(cheap_lower_bound(task_list, topology), lp_bound) if n else 0.0
     wall = time.perf_counter() - t0
+    by_name = {getattr(t, "name", None): t for t in task_list}
+    n_static = sum(
+        1 for name, a in best.assignments.items()
+        if getattr(
+            getattr(by_name.get(name), "strategies", {}).get(a.apportionment),
+            "static_prior", False,
+        )
+    )
     report = AnytimeReport(
         tier=best_tier, wall_s=wall, deadline_s=deadline, n_tasks=n,
         n_loose=n_loose, makespan=best.makespan, lower_bound=lb,
         quality=(best.makespan / lb) if lb > 1e-9 else None,
         tiers_tried=tried,
+        n_static_prior=n_static,
     )
     best.anytime = report
     return best, report
@@ -735,6 +748,7 @@ def _emit_tier_event(report: AnytimeReport, source: str) -> None:
                  else None),
         tiers_tried=list(report.tiers_tried),
         outcome=report.outcome,
+        n_static_prior=report.n_static_prior,
     )
 
 

@@ -101,6 +101,7 @@ def _runtime_identity() -> str:
     import jax
 
     from saturn_tpu.analysis import SCHEMA_VERSION as _ANALYSIS_SCHEMA
+    from saturn_tpu.analysis.shardflow import PASS_VERSION as _SHARDFLOW_PASS
 
     devs = jax.devices()
     return ";".join(
@@ -109,6 +110,10 @@ def _runtime_identity() -> str:
             # analyzer rule-set version: diagnostics-driven plan repairs
             # must never deserialize executables cached under older rules
             f"lint{_ANALYSIS_SCHEMA}",
+            # shardflow rule-set version: sharding findings gate what gets
+            # compiled, so an executable cached under one rule set must
+            # miss under another
+            f"shardflow{_SHARDFLOW_PASS}",
             f"jax:{jax.__version__}",
             f"backend:{jax.default_backend()}",
             f"machine:{platform.machine()}",

@@ -159,6 +159,11 @@ def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
 
             mesh = getattr(leaf.sharding, "mesh", None)
             if mesh is not None:
+                # sanctioned-shardflow: single-writer npz checkpoint needs
+                # the whole leaf on one host; gather is bounded to the
+                # leaf's own mesh and runs once per save, off the step hot
+                # loop. Removing the funnel entirely is ROADMAP item 6's
+                # sharded checkpoint I/O (per-host shard files).
                 rep = jax.device_put(
                     leaf, NamedSharding(mesh, PartitionSpec())
                 )
@@ -166,6 +171,9 @@ def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
             else:  # non-mesh sharding: fall back to the global gather
                 from jax.experimental import multihost_utils
 
+                # sanctioned-shardflow: rare non-mesh-sharding fallback for
+                # the same single-writer save path; superseded by ROADMAP
+                # item 6's sharded checkpoint I/O.
                 leaf = multihost_utils.process_allgather(leaf, tiled=True)
         arr = np.asarray(jax.device_get(leaf))
         # npz can't round-trip ml_dtypes (bfloat16/fp8); widen to float32 —

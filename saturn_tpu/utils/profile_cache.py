@@ -183,6 +183,7 @@ def fingerprint(
     except Exception:
         jax_version = "none"
     from saturn_tpu.analysis import SCHEMA_VERSION as _ANALYSIS_SCHEMA
+    from saturn_tpu.analysis.shardflow import PASS_VERSION as _SHARDFLOW_PASS
 
     payload = json.dumps(
         {
@@ -191,6 +192,9 @@ def fingerprint(
             # diagnostic schema must never warm-start from profiles
             # recorded under another (saturn-lint round 12).
             "analysis": _ANALYSIS_SCHEMA,
+            # Shardflow propagation-rule version: static priors recorded
+            # under one cost model must miss cleanly under another.
+            "shardflow": _SHARDFLOW_PASS,
             "task": task_sig,
             "technique": technique,
             "size": int(size),

@@ -147,6 +147,21 @@ def main() -> int:
     )
     failed |= not tsan_report.ok
 
+    # saturn-shardflow: the source half of the sharding pass (SAT-X002
+    # gather-to-replicated funnels) over the technique and kernel packages.
+    # AST-only — no jax, no devices — so it gates in any environment; the
+    # full jaxpr trace audit is ``python -m saturn_tpu.analysis shardflow``.
+    from saturn_tpu.analysis.diagnostics import AnalysisReport
+    from saturn_tpu.analysis.shardflow import passes as sf_passes
+
+    sf_report = AnalysisReport(subject="shardflow-sources")
+    sf_passes.scan_sources(sf_passes.default_source_paths(REPO), sf_report)
+    results["saturn-shardflow"] = (
+        "ok" if sf_report.ok
+        else [d.to_json() for d in sf_report.errors]
+    )
+    failed |= not sf_report.ok
+
     print(json.dumps({"metric": "lint", "results": results,
                       "status": "failed" if failed else "ok"}))
     return 1 if failed else 0
