@@ -122,6 +122,93 @@ def bench_shardflow_errors() -> list:
     return [d.to_json() for d in report.errors]
 
 
+def bench_memlens_errors() -> list:
+    """Unsanctioned SAT-M findings over the in-tree techniques
+    (saturn-memlens).
+
+    The headline number is produced by a technique's step function; a row
+    measured while that step carries an unsanctioned memory defect
+    (SAT-M003 missed donation, or SAT-M001 predicted OOM under a declared
+    capacity) bakes the defect into the baseline. The audit traces on
+    virtual CPU devices, and the device-count flag must land before jax
+    initializes — so it runs as the CLI subprocess, not in-process.
+    Returns error diagnostics (JSON form); sanctioned findings are info
+    and pass.
+    """
+    r = subprocess.run(
+        [sys.executable, "-m", "saturn_tpu.analysis", "--json", "memlens"],
+        capture_output=True, text=True, timeout=900, cwd=REPO,
+    )
+    if r.returncode == 2:
+        raise RuntimeError(
+            f"memlens audit unavailable: {(r.stderr or '').strip()[-200:]}"
+        )
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            payload = json.loads(line)
+            return [d for d in payload.get("diagnostics", [])
+                    if d.get("severity") == "error"]
+    raise RuntimeError(
+        f"memlens audit produced no JSON line (rc={r.returncode})"
+    )
+
+
+#: Required key -> type for the ``benchmarks/sweep_cache.py`` static-prune
+#: row. Same contract as the other ROW_REQUIRED tables: the bench
+#: self-validates before printing, and recorded rows can be re-checked
+#: without re-running it.
+SWEEP_PRUNE_ROW_REQUIRED = {
+    "metric": str,
+    "grid_points": int,
+    "pruned_before_lowering": int,     # acceptance bar: >= 1
+    "rejected_after_lowering": int,    # the "before" sweep's compile waste
+    "contradictions": int,             # _fits_memory vs memlens-feasible: 0
+    "before_s": float,
+    "after_s": float,
+    "saved_s": float,
+    "capacity_bytes": int,
+    "status": str,
+}
+
+
+def validate_sweep_prune_row(row) -> list:
+    """Schema-check one static-prune sweep row; returns human-readable
+    problems (empty list = valid)."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in SWEEP_PRUNE_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "sweep_static_prune":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'sweep_static_prune'"
+        )
+    pruned = row.get("pruned_before_lowering")
+    if isinstance(pruned, int) and not isinstance(pruned, bool) and pruned < 1:
+        problems.append(
+            "pruned_before_lowering < 1 (the static pass pruned nothing)"
+        )
+    c = row.get("contradictions")
+    if isinstance(c, int) and not isinstance(c, bool) and c != 0:
+        problems.append(
+            f"contradictions {c} != 0 (_fits_memory rejected a point "
+            "memlens called feasible)"
+        )
+    return problems
+
+
 #: Required key -> type for one ``benchmarks/chaos_campaign.py`` output row.
 #: The campaign bench self-validates against this before printing, and CI
 #: can re-check recorded rows — a schema drift (renamed key, stringified
@@ -374,6 +461,20 @@ def main() -> int:
         print(json.dumps({
             "metric": "bench_guard", "status": "shardflow_findings",
             "value": new.get("value"), "diagnostics": sf_errors,
+        }))
+        return 1
+    try:
+        ml_errors = bench_memlens_errors()
+    except Exception as e:
+        ml_errors = [{"code": "SAT-M000", "severity": "error",
+                      "message": f"memlens pass unavailable: "
+                                 f"{type(e).__name__}: {e}"}]
+    if ml_errors:
+        # Same refusal for the liveness pass: the row was measured by a step
+        # function carrying an unsanctioned SAT-M memory defect.
+        print(json.dumps({
+            "metric": "bench_guard", "status": "memlens_findings",
+            "value": new.get("value"), "diagnostics": ml_errors,
         }))
         return 1
     out = {

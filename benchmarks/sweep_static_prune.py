@@ -1,0 +1,185 @@
+"""Cold-sweep static-prune benchmark: what memlens buys before lowering.
+
+Two tasks on the 8-virtual-device CPU fixture — the tiny GPT-2 and a ~30x
+larger variant — swept under a synthetic per-device HBM capacity chosen
+(geometric mean of the two memlens-predicted peaks) so the small task fits
+and the large one deterministically does not:
+
+- **before**: ``SATURN_TPU_MEMLENS_PRUNE=0`` — the infeasible grid point
+  lowers, compiles, and is rejected by XLA memory analysis
+  (``_fits_memory``), paying the full compile tax to learn "no";
+- **after**: pruning on — the same point is refused statically
+  (``trial_pruned`` reason ``memlens_static``) and never lowers.
+
+Each phase sweeps a FRESH profile-cache directory and fresh task objects, so
+the delta is pruning, not cache warmth. The row also counts contradictions:
+a ``_fits_memory`` compile-time rejection of a grid point whose memlens
+prediction sat comfortably under the headroom margin would mean the static
+model blessed a point XLA refused — the acceptance bar is zero.
+
+Prints ONE JSON line (schema ``bench_guard.SWEEP_PRUNE_ROW_REQUIRED``; this
+script refuses to print a row that fails the validator):
+
+    {"metric": "sweep_static_prune", "pruned_before_lowering": ...,
+     "rejected_after_lowering": ..., "saved_s": ..., "contradictions": 0,
+     ...}
+
+Run: ``python benchmarks/sweep_static_prune.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+import timeit
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import bench_guard
+import saturn_tpu
+from saturn_tpu import HParams, Task, library
+from saturn_tpu.analysis.memlens import passes as ml_passes
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.data.lm_dataset import make_lm_dataset
+from saturn_tpu.models.gpt2 import build_gpt2
+from saturn_tpu.models.loss import pretraining_loss
+from saturn_tpu.parallel import BUILTIN_TECHNIQUES
+
+SIZE = 4
+
+#: The large task's model overrides: same vocab/seq as test-tiny so the
+#: dataloader is shared, ~30x the parameter bytes so its peak clears any
+#: capacity the small task fits under with room on both sides.
+BIG = dict(d_model=256, n_layers=4)
+
+
+def make_task(save_dir: str, name: str, big: bool) -> Task:
+    overrides = dict(BIG) if big else {}
+    return Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", **{**overrides, **kw}),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=64, batch_size=8, vocab_size=256, n_tokens=64 * 8 * 8
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=8),
+        chip_range=[SIZE],
+        name=name,
+        save_dir=save_dir,
+    )
+
+
+def predicted_peak(task: Task, topo: SliceTopology) -> int:
+    """Memlens static peak for the task's dp point (untimed setup phase)."""
+    tech = BUILTIN_TECHNIQUES["dp"]()
+    devices = topo.blocks(SIZE)[0].devices_of(topo.devices)
+    config = tech.candidate_configs(task, SIZE)[0]
+    prof = ml_passes.predict_profile(tech, task, devices, config)
+    assert prof is not None, f"memlens could not trace {task.name}"
+    return prof.peak_bytes
+
+
+def run_sweep(root: str, topo: SliceTopology, tag: str) -> tuple:
+    """One timed sweep over fresh tasks against a fresh cache; returns
+    (seconds, metrics JSONL records)."""
+    work = os.path.join(root, tag)
+    metrics_path = os.path.join(work, "metrics.jsonl")
+    tasks = [
+        make_task(work, f"{tag}-fits", big=False),
+        make_task(work, f"{tag}-oom", big=True),
+    ]
+    t0 = timeit.default_timer()
+    saturn_tpu.search(
+        tasks, technique_names=["dp"], topology=topo,
+        profile_cache=os.path.join(work, "profiles"),
+        metrics_path=metrics_path,
+    )
+    dt = timeit.default_timer() - t0
+    records = []
+    with open(metrics_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return dt, records
+
+
+def main() -> None:
+    library.register_default_library()
+    topo = SliceTopology(jax.devices())
+    root = tempfile.mkdtemp(prefix="saturn_sweep_prune_")
+    try:
+        # Untimed: pick the capacity from the two static predictions. The
+        # geometric mean sits ~sqrt(30x) from each peak — far outside both
+        # the x1.15 prune margin and the x0.92 compile headroom, so the
+        # verdicts are insensitive to the static model's calibration ratio.
+        p_fits = predicted_peak(make_task(os.path.join(root, "p0"), "p-fits",
+                                          big=False), topo)
+        p_oom = predicted_peak(make_task(os.path.join(root, "p1"), "p-oom",
+                                         big=True), topo)
+        capacity = int(math.sqrt(float(p_fits) * float(p_oom)))
+        os.environ[ml_passes.ENV_CAPACITY] = str(capacity)
+
+        os.environ["SATURN_TPU_MEMLENS_PRUNE"] = "0"
+        before_s, before_ev = run_sweep(root, topo, "before")
+        os.environ["SATURN_TPU_MEMLENS_PRUNE"] = "1"
+        after_s, after_ev = run_sweep(root, topo, "after")
+    finally:
+        os.environ.pop(ml_passes.ENV_CAPACITY, None)
+        os.environ.pop("SATURN_TPU_MEMLENS_PRUNE", None)
+        shutil.rmtree(root, ignore_errors=True)
+
+    rejected = sum(
+        1 for r in before_ev
+        if r.get("kind") == "trial" and r.get("memory_infeasible")
+    )
+    pruned = sum(
+        1 for r in after_ev
+        if r.get("kind") == "trial_pruned" and r.get("reason") == "memlens_static"
+    )
+    # A compile-time memory rejection of a point memlens placed comfortably
+    # under the headroom margin contradicts the static verdict. The -oom
+    # rejections in the before phase are the measured waste, not
+    # contradictions: memlens predicted those OOM too.
+    peak_of = {"fits": p_fits, "oom": p_oom}
+    contradictions = sum(
+        1 for r in before_ev + after_ev
+        if r.get("kind") == "trial" and r.get("memory_infeasible")
+        and peak_of[str(r.get("task", "")).rsplit("-", 1)[-1]]
+        <= ml_passes.HEADROOM_MARGIN * capacity
+    )
+
+    row = {
+        "metric": "sweep_static_prune",
+        "grid_points": 2,
+        "pruned_before_lowering": pruned,
+        "rejected_after_lowering": rejected,
+        "contradictions": contradictions,
+        "before_s": round(before_s, 3),
+        "after_s": round(after_s, 3),
+        "saved_s": round(before_s - after_s, 3),
+        "capacity_bytes": capacity,
+        "status": "ok",
+    }
+    problems = bench_guard.validate_sweep_prune_row(row)
+    if problems:
+        print(json.dumps({"metric": "sweep_static_prune", "status": "invalid",
+                          "problems": problems, "row": row}))
+        sys.exit(1)
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

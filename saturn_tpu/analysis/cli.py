@@ -324,6 +324,53 @@ def _cmd_shardflow(args: argparse.Namespace) -> int:
     return rc
 
 
+def _cmd_memlens(args: argparse.Namespace) -> int:
+    import os
+
+    # Same virtual-device dance as shardflow: the liveness audit traces
+    # techniques at a probe sub-mesh size on virtual CPU devices, and the
+    # device-count flag must land before jax initializes.
+    if "jax" not in sys.modules:
+        want = args.size * 2
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={want}"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from saturn_tpu.analysis.memlens import passes as ml_passes
+
+    try:
+        report, profiles = ml_passes.audit_intree(
+            size=args.size, capacity_bytes=args.capacity,
+            window=args.window,
+        )
+    except (OSError, ImportError, RuntimeError) as e:
+        print(f"memlens audit failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        payload = report.to_json()
+        payload["profiles"] = {
+            name: prof.to_json() for name, prof in sorted(profiles.items())
+        }
+        print(json.dumps(payload, sort_keys=True, default=str))
+        return 0 if report.ok else 1
+    rc = _emit(report, False)
+    if args.profile:
+        for name, prof in sorted(profiles.items()):
+            print(
+                f"  {name}: peak {prof.peak_bytes}B "
+                f"(persistent {prof.persistent_bytes}B + transient "
+                f"{prof.transient_peak_bytes}B; scratch "
+                f"{prof.collective_scratch_peak}B; host {prof.host_bytes}B); "
+                f"largest temp {prof.largest_temp_bytes}B "
+                f"@ {prof.largest_temp_where or '?'}"
+            )
+    return rc
+
+
 def _percentile(values, q: float) -> float:
     xs = sorted(values)
     if not xs:
@@ -482,6 +529,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     x.add_argument("--ledger", action="store_true",
                    help="also print per-technique collective byte totals")
     x.set_defaults(fn=_cmd_shardflow)
+
+    m = sub.add_parser(
+        "memlens",
+        help="saturn-memlens: static per-device HBM peak-liveness audit "
+             "over every in-tree technique (SAT-M findings; zero compiles)",
+    )
+    m.add_argument("--size", type=int, default=4,
+                   help="probe sub-mesh size (default 4)")
+    m.add_argument("--capacity", type=int, default=None,
+                   help="per-device HBM capacity in bytes (default: "
+                        "SATURN_TPU_HBM_BYTES, then the device's own "
+                        "report; unknown capacity skips SAT-M001/M004)")
+    m.add_argument("--window", type=int, default=1,
+                   help="fused dispatch window K to model (default 1)")
+    m.add_argument("--profile", action="store_true",
+                   help="also print per-technique peak/persistent/"
+                        "transient byte splits")
+    m.set_defaults(fn=_cmd_memlens)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -104,6 +104,32 @@ SAT-X005   warning   static communication estimate vs. profiled runtime
 A ``# sanctioned-shardflow: <reason>`` comment on the finding line or in
 the contiguous comment block above it downgrades a SAT-X finding to
 ``info`` — sanctions explain, they never silence.
+
+Peak-liveness pass (``SAT-M*``) — ``analysis.memlens`` (saturn-memlens):
+
+========== ========= ===========================================================
+SAT-M000   warning   technique untraceable at the probe size (nothing else
+                     checked for it)
+SAT-M001   error     predicted OOM: the static per-device HBM peak exceeds
+                     capacity by the prune margin — deterministic
+                     infeasibility before any compile
+SAT-M002   warning   peak dominated by one oversized temporary (>= 50% of the
+                     transient peak and >= 16 MiB) — one remat/reshard moves
+                     the whole peak
+SAT-M003   error     missed donation: a non-donated input's shape/dtype
+                     matches an output, so XLA cannot alias it and the buffer
+                     is resident twice
+SAT-M004   warning   headroom below the allocator margin (peak within 8% of
+                     capacity but under it) — fragmentation risk
+SAT-M005   warning   static peak vs ``compiled.memory_analysis()`` drift
+                     beyond the calibration ratio — the liveness model is
+                     miscalibrated for this workload
+========== ========= ===========================================================
+
+A ``# sanctioned-memlens: <reason>`` comment at a finding's file:line
+provenance (or the contiguous comment block above it) downgrades a SAT-M
+finding to ``info`` — sanctions explain, they never silence; eqn#-style
+provenance cannot be sanctioned.
 """
 
 from __future__ import annotations
@@ -117,8 +143,9 @@ from typing import Any, Dict, List, Optional, Tuple
 #: and AOT cache fingerprints (``utils/profile_cache.py``,
 #: ``utils/aot_cache.py``) so a plan repaired under one rule set never reads
 #: back cache entries recorded under another. 2 -> 3: saturn-shardflow
-#: (SAT-X sharding-propagation pass + cold-start prior).
-SCHEMA_VERSION = 3
+#: (SAT-X sharding-propagation pass + cold-start prior). 3 -> 4:
+#: saturn-memlens (SAT-M peak-liveness pass + zero-compile feasibility).
+SCHEMA_VERSION = 4
 
 #: severity levels, weakest to strongest
 SEVERITIES = ("info", "warning", "error")

@@ -1,0 +1,38 @@
+"""saturn-memlens: static HBM peak-liveness analysis + zero-compile priors.
+
+Two passes over a technique's traced step function (abstract values only
+— CPU, no chip, no compile):
+
+- :mod:`.liveness` — a peak-liveness abstract interpreter riding
+  shardflow's PartitionSpec propagation (it subclasses the shardflow
+  :class:`~saturn_tpu.analysis.shardflow.interp.Interpreter`): linear-scan
+  liveness over the jaxpr's equations with per-shard bytes from the
+  propagated specs, donation-aware frees (donated args release at their
+  last read), remat/scan/while/pjit recursion (remat bodies contribute
+  transient-only, scan carries persist across the trip), collective
+  scratch accounting (all-gather / all-reduce buffers from the shardflow
+  ledger hooks), pinned-host exclusion for offload configs, and a
+  persistent-vs-transient split (params/opt-state vs activations);
+- :mod:`.passes` — SAT-M diagnostics with file:line-ish provenance
+  (SAT-M001 predicted OOM, SAT-M002 peak dominated by one oversized
+  temporary, SAT-M003 missed donation, SAT-M004 headroom below margin,
+  SAT-M005 static-vs-compiled drift audit, SAT-M000 untraceable),
+  sanctionable via ``# sanctioned-memlens: reason`` markers (downgrade
+  to info, never silence), plus the feasibility verdicts the three
+  consumers read: the trial runner's pre-lowering grid pruning, the
+  admission controller's memory-aware cold-start gate, and the elastic
+  replanner's migration destination-fit check.
+
+Import-light at package level (the CLI must be able to set XLA device
+flags before jax loads); everything heavier is imported inside functions.
+"""
+
+from __future__ import annotations
+
+#: Version of the memlens rule set (liveness model, diagnostic meanings,
+#: feasibility margins). Folded into the profile-cache fingerprint and the
+#: AOT-cache runtime identity so feasibility entries recorded under one
+#: liveness model miss cleanly under another.
+PASS_VERSION = 1
+
+__all__ = ["PASS_VERSION"]

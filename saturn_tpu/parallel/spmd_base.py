@@ -58,6 +58,16 @@ DEFAULT_MAX_WINDOW = 8
 _ENV_MAX_WINDOW = "SATURN_TPU_MAX_WINDOW"
 
 
+def _env_hbm_bytes() -> int:
+    """SATURN_TPU_HBM_BYTES (memlens's capacity override) as an int, 0
+    when unset/garbage — platforms that report no memory stats fall back
+    to it so compile-time rejection works on CPU sweeps too."""
+    try:
+        return max(int(float(os.environ.get("SATURN_TPU_HBM_BYTES", "0"))), 0)
+    except ValueError:
+        return 0
+
+
 def max_window() -> int:
     """Ceiling on the fused window K (env ``SATURN_TPU_MAX_WINDOW``).
 
@@ -732,23 +742,46 @@ class SPMDTechnique(BaseTechnique):
             "technique": self.name,
             "size": len(devices),
             "config": dict(config),
+            # memlens: pinned-host configs keep resident params/opt-state
+            # in host memory, so the liveness pass excludes them from HBM
+            "param_memory_kind": self.param_memory_kind(config),
         }
 
     # ------------------------------------------------------------ feasibility
-    def _fits_memory(self, bundle: _Bundle, devices: Sequence[Any]) -> bool:
+    def _fits_memory(
+        self, bundle: _Bundle, devices: Sequence[Any],
+        task: Any = None, config: Optional[Dict[str, Any]] = None,
+    ) -> bool:
         """XLA compile-time memory check (replaces OOM probes,
         ``Spilled.py:68-87``)."""
-        return self._fits_compiled(bundle.compiled, devices)
+        return self._fits_compiled(bundle.compiled, devices,
+                                   task=task, config=config, k=1)
 
-    def _fits_compiled(self, compiled: Any, devices: Sequence[Any]) -> bool:
+    def _fits_compiled(
+        self, compiled: Any, devices: Sequence[Any], *,
+        task: Any = None, config: Optional[Dict[str, Any]] = None,
+        k: int = 1,
+    ) -> bool:
         """Memory check against a specific compiled program — the fused
         K-step trial analyzes the window program it will actually time (its
         peak includes the (K, B, T) staged stack the 1-step program never
-        holds)."""
+        holds).
+
+        When the caller knows the (task, config) this program came from,
+        every check also emits a ``memlens_calibration`` metrics event —
+        static predicted bytes next to the compiled figure — so the
+        SAT-M005 drift audit accrues for free on every sweep.
+        """
         limit = device_hbm_bytes(devices[0])
         if limit <= 0:
-            return True  # platform doesn't report limits (CPU tests)
+            # platform doesn't report limits (CPU tests); honor the same
+            # env capacity memlens reads, so CPU sweeps can model a chip
+            limit = _env_hbm_bytes()
         need = hbm_bytes_required(compiled)
+        if task is not None and config is not None:
+            self._memlens_calibration(task, devices, config, need, k)
+        if limit <= 0:
+            return True
         ok = need == 0 or need <= 0.92 * limit
         if not ok:
             log.info(
@@ -756,6 +789,37 @@ class SPMDTechnique(BaseTechnique):
                 self.name, need / 2**30, limit / 2**30,
             )
         return ok
+
+    def _memlens_calibration(
+        self, task: Any, devices: Sequence[Any], config: Dict[str, Any],
+        compiled_bytes: int, k: int,
+    ) -> None:
+        """Best-effort static-vs-compiled comparison; never raises and
+        never changes the feasibility outcome."""
+        try:
+            from saturn_tpu.analysis.memlens import liveness as _ml_liveness
+            from saturn_tpu.analysis.memlens import passes as _ml_passes
+            from saturn_tpu.utils import metrics as _metrics
+
+            traced = self.trace_step(task, list(devices), dict(config))
+            profile = _ml_liveness.analyze(traced, window=k)
+            _metrics.event(
+                "memlens_calibration",
+                technique=self.name,
+                task=getattr(task, "name", "?"),
+                size=len(devices),
+                k=int(k),
+                predicted_bytes=int(profile.peak_bytes),
+                compiled_bytes=int(compiled_bytes),
+            )
+            drift = _ml_passes.audit_point(
+                profile.peak_bytes, compiled_bytes, self.name,
+                len(devices), k=k,
+            )
+            if drift is not None:
+                log.warning("%s", drift.message)
+        except Exception as e:
+            log.debug("memlens calibration skipped: %r", e)
 
     def _fused_ok(self, config: Dict[str, Any]) -> bool:
         """Whether THIS config may run fused windows. Pinned-host configs
@@ -836,7 +900,8 @@ class SPMDTechnique(BaseTechnique):
             # at execute() time the prefetcher overlaps staging with
             # compute, so a trial that timed staging would overestimate.
             fused = bundle.fused_compiled(k)
-            if not self._fits_compiled(fused, devices):
+            if not self._fits_compiled(fused, devices,
+                                       task=task, config=config, k=k):
                 return None
             ds = task.get_dataset()
             sharding = bundle.stacked_sharding()
@@ -857,7 +922,7 @@ class SPMDTechnique(BaseTechnique):
             t_host = (_timeit.default_timer() - t0) / k
             del probe
             return t, _host_fraction(t_host, t)
-        if not self._fits_memory(bundle, devices):
+        if not self._fits_memory(bundle, devices, task=task, config=config):
             return None
         state = bundle.init()
         t0 = _timeit.default_timer()

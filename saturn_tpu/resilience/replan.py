@@ -219,6 +219,19 @@ class ElasticReplanner:
         topo = base_topology.subset(alive_indices)
         cap = topo.capacity
 
+        # Memlens destination-fit gate: with a known per-device HBM
+        # capacity, the static liveness analysis vets the surviving mesh
+        # before any migration commits — both at keep/evict time (a task
+        # whose every fitting strategy is predicted OOM on the degraded
+        # mesh is evicted, not resharded into an OOM loop) and per planned
+        # migration below. Fails open whenever capacity or a trace is
+        # unknown.
+        try:
+            from saturn_tpu.analysis.memlens import passes as ml_passes
+            cap_bytes = ml_passes.hbm_capacity_bytes(topo.devices)
+        except Exception:
+            ml_passes, cap_bytes = None, 0
+
         synthesized: Dict[str, List[int]] = {}
         keep: List = []
         evicted: List[str] = []
@@ -227,14 +240,22 @@ class ElasticReplanner:
                 added = self._synthesize(t, cap)
                 if added:
                     synthesized[t.name] = added
-            if _runnable(t, cap):
-                keep.append(t)
-            else:
+            if not _runnable(t, cap):
                 evicted.append(t.name)
                 log.warning(
                     "replan: task %s cannot run on %d-device mesh — evicting",
                     t.name, cap,
                 )
+            elif cap_bytes > 0 and not ml_passes.task_fits_mesh(
+                    t, topo, cap_bytes):
+                evicted.append(t.name)
+                log.warning(
+                    "replan: task %s predicted over HBM at every fitting "
+                    "size on the %d-device mesh (memlens) — evicting",
+                    t.name, cap,
+                )
+            else:
+                keep.append(t)
 
         ctx = ReplanContext(
             topology=topo,
@@ -263,6 +284,35 @@ class ElasticReplanner:
         migrations = (
             plan.migrations_from(previous_plan) if previous_plan is not None else {}
         )
+        # Destination-fit check per planned migration: the restored
+        # checkpoint shards plus the steady-state peak must fit the
+        # destination block. The verdict is attached to the migration
+        # record the caller commits from; a predicted misfit is flagged
+        # loudly (the pre-solve eviction above catches the deterministic
+        # cases, so a flag here means the chosen size specifically drifted).
+        memlens_blocked: List[str] = []
+        if cap_bytes > 0:
+            by_name = {t.name: t for t in task_list}
+            for name, d in migrations.items():
+                if not d.get("moved"):
+                    continue
+                t = by_name.get(name)
+                a = plan.assignments.get(name)
+                if t is None or a is None:
+                    continue
+                fit = ml_passes.migration_fits(
+                    t, topo, a.apportionment, cap_bytes)
+                if fit is None:
+                    continue
+                d["memlens"] = fit
+                if not fit["fits"]:
+                    memlens_blocked.append(name)
+                    log.warning(
+                        "replan: migrating %s to %d chips is predicted over "
+                        "HBM (%d B restored shards + peak %d B > %d B)",
+                        name, a.apportionment, fit["restored_shard_bytes"],
+                        fit["peak_bytes"], cap_bytes,
+                    )
         metrics.event(
             "replan",
             policy=self.policy,
@@ -272,6 +322,7 @@ class ElasticReplanner:
             synthesized={k: v for k, v in synthesized.items()},
             makespan_s=plan.makespan,
             migrated=sorted(n for n, d in migrations.items() if d["moved"]),
+            memlens_blocked=sorted(memlens_blocked),
         )
         return ReplanResult(
             topology=topo,

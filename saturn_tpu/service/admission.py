@@ -112,6 +112,28 @@ class AdmissionController:
         self.queue.mark(rec, JobState.PROFILING)
         task = rec.task
 
+        # Memlens cold-start memory gate: before any trial or compile, the
+        # static liveness analysis checks every fitting (technique, size,
+        # config) grid point against per-device HBM capacity. A verdict
+        # only exists when capacity is known AND every point traced and
+        # predicted OOM — anything unknown falls through to the sweep, and
+        # the compile-time check stays the authoritative backstop.
+        mem = self._memlens_verdict(task, topology)
+        if mem is not None and not mem["fits"]:
+            degraded = topology.capacity < self.base_capacity
+            dec = AdmissionDecision(
+                DEFER if degraded else REJECT,
+                reason=(
+                    f"memlens: predicted per-device HBM peak "
+                    f"{mem['min_peak_bytes']} B exceeds capacity "
+                    f"{mem['capacity_bytes']} B at every fitting size "
+                    f"({mem['checked']} grid points, zero trials)"
+                ),
+                latency_s=timeit.default_timer() - t0,
+            )
+            self._note(rec, dec)
+            return dec
+
         trials = 0
         used_prior = False
         if self.static_priors and not task.feasible_strategies():
@@ -210,6 +232,27 @@ class AdmissionController:
         )
         self._note(rec, dec)
         return dec
+
+    # -------------------------------------------------------------- memlens
+    def _memlens_verdict(self, task, topology: SliceTopology):
+        """Zero-trial memory verdict (or None). Restricted to this
+        controller's technique roster; fails open on any error."""
+        try:
+            from saturn_tpu.analysis.memlens import passes as ml_passes
+            from saturn_tpu.parallel import BUILTIN_TECHNIQUES
+
+            names = self.technique_names or sorted(BUILTIN_TECHNIQUES)
+            techniques = {
+                n: (BUILTIN_TECHNIQUES[n]()
+                    if isinstance(BUILTIN_TECHNIQUES[n], type)
+                    else BUILTIN_TECHNIQUES[n])
+                for n in names if n in BUILTIN_TECHNIQUES
+            }
+            return ml_passes.coldstart_verdict(
+                task, topology, techniques=techniques)
+        except Exception as e:
+            logger.debug("admission: memlens verdict skipped: %r", e)
+            return None
 
     # ------------------------------------------------------------ shardflow
     def _synthesize_priors(self, rec: JobRecord, task,

@@ -46,6 +46,7 @@ cheap (see ``docs/architecture.md`` "Profiling cost & caching"):
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import random
 import threading
@@ -478,6 +479,30 @@ def _search_inner(
             tid_counter[0] += 1
             return tid_counter[0]
 
+    # memlens static pre-lowering prune: with a known per-device HBM
+    # capacity, a grid point whose statically predicted peak clears the
+    # OOM margin for EVERY candidate config never lowers at all. The
+    # compile-time _fits_memory check stays the authoritative backstop
+    # for everything that does run (and feeds SAT-M005 calibration).
+    memlens_cap = 0
+    ml_passes = None
+    if prune and os.environ.get("SATURN_TPU_MEMLENS_PRUNE", "1") != "0":
+        try:
+            from saturn_tpu.analysis.memlens import passes as ml_passes
+            memlens_cap = ml_passes.hbm_capacity_bytes(topo.devices)
+        except Exception:
+            memlens_cap = 0
+
+    def memlens_infeasible(lane: _Lane, g: int) -> bool:
+        if memlens_cap <= 0:
+            return False
+        try:
+            devices = topo.blocks(g)[0].devices_of(topo.devices)
+            return ml_passes.grid_point_infeasible(
+                lane.tech, lane.task, devices, memlens_cap)
+        except Exception:
+            return False
+
     run_sizes = sorted({g for lane in lanes for g in lane.to_run}, reverse=True)
     for g in run_sizes:
         items: List[_Lane] = []
@@ -486,6 +511,9 @@ def _search_inner(
                 continue
             if lane.pruned(g):
                 prune_point(lane, g, "memory_monotone", planned=True)
+            elif memlens_infeasible(lane, g):
+                prune_point(lane, g, "memlens_static", planned=True)
+                note_memory_floor(lane, g)
             else:
                 items.append(lane)
         if not items:

@@ -101,6 +101,7 @@ def _runtime_identity() -> str:
     import jax
 
     from saturn_tpu.analysis import SCHEMA_VERSION as _ANALYSIS_SCHEMA
+    from saturn_tpu.analysis.memlens import PASS_VERSION as _MEMLENS_PASS
     from saturn_tpu.analysis.shardflow import PASS_VERSION as _SHARDFLOW_PASS
 
     devs = jax.devices()
@@ -114,6 +115,10 @@ def _runtime_identity() -> str:
             # compiled, so an executable cached under one rule set must
             # miss under another
             f"shardflow{_SHARDFLOW_PASS}",
+            # memlens liveness-model version: static feasibility verdicts
+            # gate what lowers at all, so executables cached under one
+            # liveness model must miss under another
+            f"memlens{_MEMLENS_PASS}",
             f"jax:{jax.__version__}",
             f"backend:{jax.default_backend()}",
             f"machine:{platform.machine()}",

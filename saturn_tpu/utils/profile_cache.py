@@ -183,6 +183,7 @@ def fingerprint(
     except Exception:
         jax_version = "none"
     from saturn_tpu.analysis import SCHEMA_VERSION as _ANALYSIS_SCHEMA
+    from saturn_tpu.analysis.memlens import PASS_VERSION as _MEMLENS_PASS
     from saturn_tpu.analysis.shardflow import PASS_VERSION as _SHARDFLOW_PASS
 
     payload = json.dumps(
@@ -195,6 +196,10 @@ def fingerprint(
             # Shardflow propagation-rule version: static priors recorded
             # under one cost model must miss cleanly under another.
             "shardflow": _SHARDFLOW_PASS,
+            # Memlens liveness-model version: memory-infeasibility entries
+            # (including statically pruned points) recorded under one
+            # liveness model must miss cleanly under another.
+            "memlens": _MEMLENS_PASS,
             "task": task_sig,
             "technique": technique,
             "size": int(size),

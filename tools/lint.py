@@ -97,6 +97,16 @@ def main() -> int:
     results = {}
     failed = False
 
+    # The memlens gate below traces techniques at a probe sub-mesh size;
+    # the virtual-device flag must land before anything imports jax.
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
     if _have("ruff"):
         rc = _run([sys.executable, "-m", "ruff", "check", "saturn_tpu",
                    "tests", "tools", "benchmarks"])
@@ -161,6 +171,25 @@ def main() -> int:
         else [d.to_json() for d in sf_report.errors]
     )
     failed |= not sf_report.ok
+
+    # saturn-memlens: the peak-liveness audit over every in-tree
+    # technique's traced step. Gates on unsanctioned SAT-M001/M003 errors
+    # (predicted OOM / missed donation); without a known HBM capacity only
+    # M003 can fire, which is exactly the source invariant — in-tree step
+    # functions must donate their state. An environment whose jax cannot
+    # trace at all skips, per the gate-on-absence rule.
+    from saturn_tpu.analysis.memlens import passes as ml_passes
+
+    try:
+        ml_report, _ = ml_passes.audit_intree(size=4)
+    except Exception as e:
+        results["saturn-memlens"] = f"skipped ({type(e).__name__}: {e})"
+    else:
+        results["saturn-memlens"] = (
+            "ok" if ml_report.ok
+            else [d.to_json() for d in ml_report.errors]
+        )
+        failed |= not ml_report.ok
 
     print(json.dumps({"metric": "lint", "results": results,
                       "status": "failed" if failed else "ok"}))
