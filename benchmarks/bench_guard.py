@@ -397,6 +397,113 @@ def validate_solver_row(row) -> list:
     return problems
 
 
+#: Required key -> type for the ``benchmarks/billion_scale.py`` checkpoint
+#: I/O row (allgather-writer vs sharded-manifest save/restore timings). Same
+#: contract as the other ROW_REQUIRED tables: the bench self-validates
+#: before printing, and recorded rows can be re-checked without re-running.
+CKPT_ROW_REQUIRED = {
+    "metric": str,                  # "ckpt_io"
+    "preset": str,
+    "platform": str,
+    "n_devices": int,
+    "state_bytes": int,             # full train-state bytes on host
+    "allgather_save_s": float,      # emulated legacy single-writer save
+    "sharded_save_s": float,        # manifest + per-rank shard files, cold
+    "sharded_async_block_s": float,  # caller-visible save_async latency
+    "sharded_restore_s": float,     # restore_sharded onto a resized mesh
+    "restore_bit_identical": bool,  # hard acceptance bar: must be True
+    "shard_files": int,
+    "speedup_vs_allgather": float,  # allgather_save_s / sharded_save_s
+    "status": str,
+}
+
+
+def latest_ckpt_record():
+    """(round, ckpt-row) of the newest ``BENCH_r*.json`` carrying a valid
+    ``ckpt`` row, or None. Lives under the record's ``"ckpt"`` key — never
+    under ``"parsed"`` — so checkpoint rows and headline-throughput rows
+    can't gate each other."""
+    best = None
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        row = rec.get("ckpt")
+        if not isinstance(row, dict) or validate_ckpt_row(row):
+            continue
+        n = int(m.group(1))
+        if best is None or n > best[0]:
+            best = (n, row)
+    return best
+
+
+def validate_ckpt_row(row, reference=None, pct=10.0) -> list:
+    """Schema-check one checkpoint-I/O row; returns human-readable problems
+    (empty list = valid). With ``reference`` (a previously recorded row of
+    the same shape) also enforces the regression bar: the sharded save must
+    not be more than ``pct`` percent slower than the recorded one."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in CKPT_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "ckpt_io":
+        problems.append(f"metric is {row.get('metric')!r}, expected 'ckpt_io'")
+    if row.get("restore_bit_identical") is not True:
+        problems.append(
+            "restore_bit_identical is not True — the sharded round trip "
+            "corrupted at least one leaf"
+        )
+    for key in ("sharded_save_s", "allgather_save_s", "sharded_restore_s"):
+        v = row.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v <= 0:
+            problems.append(f"{key} {v} <= 0")
+    blk = row.get("sharded_async_block_s")
+    cold = row.get("sharded_save_s")
+    if (isinstance(blk, (int, float)) and isinstance(cold, (int, float))
+            and not isinstance(blk, bool) and not isinstance(cold, bool)
+            and cold > 0 and blk > cold * 1.5):
+        problems.append(
+            f"sharded_async_block_s {blk} > 1.5x cold save {cold} — the "
+            "async path is not overlapping the disk write"
+        )
+    if isinstance(reference, dict):
+        same_shape = all(
+            row.get(k) == reference.get(k)
+            for k in ("preset", "platform", "n_devices")
+        )
+        ref_s = reference.get("sharded_save_s")
+        new_s = row.get("sharded_save_s")
+        if (same_shape
+                and isinstance(ref_s, (int, float))
+                and isinstance(new_s, (int, float))
+                and not isinstance(ref_s, bool)
+                and not isinstance(new_s, bool)
+                and ref_s > 0
+                and new_s > ref_s * (1.0 + pct / 100.0)):
+            problems.append(
+                f"sharded_save_s {new_s} regressed >{pct}% vs recorded "
+                f"{ref_s}"
+            )
+    return problems
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (

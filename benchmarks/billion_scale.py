@@ -23,10 +23,123 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import timeit
 
 import numpy as np
+
+
+def _ckpt_phase(args, spec_shapes) -> dict:
+    """Round-19 checkpoint-I/O row: time the legacy allgather-one-writer
+    save (emulated inline — the production writer no longer has a gather
+    path) against the sharded-manifest format, cold and async-overlapped,
+    then restore onto a *resized* mesh and bit-compare every leaf.
+
+    The legacy emulation is exactly what ``utils/checkpoint.save`` used to
+    do: replicate each leaf across the mesh (``P()``), pull the full array
+    to one host, and write a single ``np.savez`` archive. The sharded
+    writer copies only per-shard local bytes, so the delta is the gather
+    funnel the round removed.
+    """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from saturn_tpu.utils import checkpoint as ckpt
+
+    devices = jax.devices()
+    ndev = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+
+    # Deterministic host tree at the model's real leaf shapes (plus the 0-d
+    # step counter every train state carries) — cheap to build, and the
+    # bytes are reproducible for the bit-identity check.
+    rng = np.random.default_rng(0)
+    host = {"step": np.asarray(1234, dtype=np.int32)}
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(spec_shapes)):
+        host[f"w{i}"] = rng.standard_normal(
+            int(np.prod(leaf.shape)), dtype=np.float32
+        ).reshape(leaf.shape)
+    state_bytes = sum(a.nbytes for a in host.values())
+
+    def rule(arr):
+        if arr.ndim and arr.shape[0] % ndev == 0:
+            return NamedSharding(mesh, P("dp"))
+        return NamedSharding(mesh, P())
+
+    state = {k: jax.device_put(v, rule(v)) for k, v in host.items()}
+
+    base = os.path.join(args.ckpt_dir, "bench")
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    legacy_path = base + ".legacy.npz"
+    sharded_path = base + ".npz"
+
+    # -- legacy allgather writer (what save() did before round 19) --------
+    t0 = timeit.default_timer()
+    gathered = {}
+    for k, v in state.items():
+        rep = jax.device_put(v, NamedSharding(mesh, P()))
+        gathered[k] = np.asarray(jax.device_get(rep.addressable_data(0)))
+    tmp = legacy_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **gathered)
+    os.replace(tmp, legacy_path)
+    allgather_s = timeit.default_timer() - t0
+    del gathered
+
+    # -- sharded manifest, cold ------------------------------------------
+    t0 = timeit.default_timer()
+    ckpt.save(sharded_path, state)
+    sharded_s = timeit.default_timer() - t0
+
+    # -- sharded manifest, async: caller-visible latency is snapshot-only -
+    t0 = timeit.default_timer()
+    ckpt.save_async(sharded_path, state)
+    async_block_s = timeit.default_timer() - t0
+    ckpt.flush()
+
+    # -- restore onto a resized mesh (migration path) --------------------
+    half = max(ndev // 2, 1)
+    mesh2 = Mesh(np.asarray(devices[:half]), ("dp",))
+
+    def rule2(tree_path, shape_struct):
+        if shape_struct.ndim and shape_struct.shape[0] % half == 0:
+            return NamedSharding(mesh2, P("dp"))
+        return NamedSharding(mesh2, P())
+
+    t0 = timeit.default_timer()
+    restored = ckpt.restore_sharded(sharded_path, state, rule2)
+    jax.block_until_ready(restored)
+    restore_s = timeit.default_timer() - t0
+
+    identical = all(
+        np.asarray(jax.device_get(restored[k])).tobytes() == host[k].tobytes()
+        for k in host
+    )
+
+    manifest_bytes = os.path.getsize(sharded_path)
+    shard_files = len([
+        n for n in os.listdir(args.ckpt_dir)
+        if ckpt._SHARD_RE.search(n)
+        and n.startswith(os.path.basename(sharded_path))
+    ])
+
+    return {
+        "metric": "ckpt_io",
+        "preset": args.preset,
+        "platform": jax.devices()[0].platform,
+        "n_devices": ndev,
+        "state_bytes": int(state_bytes),
+        "allgather_save_s": round(allgather_s, 4),
+        "sharded_save_s": round(sharded_s, 4),
+        "sharded_async_block_s": round(async_block_s, 4),
+        "sharded_restore_s": round(restore_s, 4),
+        "restore_bit_identical": bool(identical),
+        "shard_files": shard_files,
+        "speedup_vs_allgather": round(allgather_s / max(sharded_s, 1e-9), 3),
+        "manifest_bytes": int(manifest_bytes),
+        "status": "ok" if identical else "failed",
+    }
 
 
 def main() -> None:
@@ -40,12 +153,25 @@ def main() -> None:
     ap.add_argument("--platform", choices=["default", "cpu"], default="default")
     ap.add_argument("--stream", type=int, default=1)
     ap.add_argument("--remat", type=int, default=1)
+    ap.add_argument("--ckpt", type=int, default=1,
+                    help="also run the checkpoint-I/O phase (ckpt_io row)")
+    ap.add_argument("--ckpt-only", action="store_true",
+                    help="skip the offload training phase (e.g. on hosts "
+                         "whose jax lacks the pinned_host memory API) and "
+                         "emit only the ckpt_io row")
+    ap.add_argument("--ckpt-dir", default="/tmp/saturn_billion_ckpts/io_bench")
     args = ap.parse_args()
 
     if args.platform == "cpu":
-        import os
-
         os.environ["JAX_PLATFORMS"] = "cpu"
+        if args.ckpt:
+            # the ckpt phase needs a real mesh to shard over; the offload
+            # phase still pins itself to devices[:1]
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -74,6 +200,10 @@ def main() -> None:
     print(f"{args.preset}: {n_params/1e9:.2f}B params, "
           f"b{args.batch}x{args.seq}, layers={spec.config.n_layers}",
           file=sys.stderr)
+
+    if args.ckpt_only:
+        _emit_ckpt_row(args, shapes)
+        return
 
     task = Task(
         get_model=get_model,
@@ -124,6 +254,28 @@ def main() -> None:
         "platform": dev.platform,
     }
     print(json.dumps(out))
+
+    if args.ckpt:
+        _emit_ckpt_row(args, shapes)
+
+
+def _emit_ckpt_row(args, shapes) -> None:
+    """Run the ckpt-I/O phase, self-validate against the schema (and the
+    recorded row's regression bar, if any), and print the JSON row."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import bench_guard
+
+    row = _ckpt_phase(args, shapes)
+    ref = bench_guard.latest_ckpt_record()
+    problems = bench_guard.validate_ckpt_row(
+        row, reference=ref[1] if ref else None
+    )
+    if problems:
+        for p in problems:
+            print(f"ckpt_io row invalid: {p}", file=sys.stderr)
+        print(json.dumps(row))
+        sys.exit(1)
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

@@ -134,10 +134,10 @@ def main():
     saturn_tpu.orchestrate(tasks, log=True, interval=args.interval)
     print(f"orchestration took {time.time() - t0:.1f}s for {len(tasks)} tasks")
 
-    import numpy as np
+    from saturn_tpu.utils import checkpoint as ckpt_mod
 
     for t in tasks:
-        step = int(np.load(t.ckpt_path)["step"])
+        step = int(ckpt_mod.load_arrays(t.ckpt_path)["step"])
         print(f"  {t.name}: trained steps={step} remaining={t.total_batches}")
 
 

@@ -63,7 +63,8 @@ class VerifyInstall(unittest.TestCase):
         saturn_tpu.orchestrate([self.task], log=True, interval=30.0)
         self.assertEqual(self.task.total_batches, 0)
         self.assertTrue(self.task.has_ckpt())
-        self.assertEqual(int(np.load(self.task.ckpt_path)["step"]), 12)
+        from saturn_tpu.utils import checkpoint as ckpt_mod
+        self.assertEqual(int(ckpt_mod.load_arrays(self.task.ckpt_path)["step"]), 12)
 
 
 if __name__ == "__main__":

@@ -34,6 +34,10 @@ Subcommands:
   source scan (SAT-X002) over ``parallel/``, ``ops/`` and
   ``utils/checkpoint.py``.  ``--size`` sets the probe sub-mesh size,
   ``--ledger`` prints per-technique collective byte totals.
+- ``ckpt DIR``: inspect a checkpoint directory — per-manifest shard/leaf
+  counts, on-disk bytes, PartitionSpec fingerprint, quarantined
+  ``.corrupt`` sidecars and orphan shard files no manifest references.
+  Exit 1 when any checkpoint fails verification.
 
 Exit code 0 = no error-severity diagnostics; 1 = at least one error;
 2 = usage/IO failure.  ``--json`` prints the machine-readable report.
@@ -371,6 +375,46 @@ def _cmd_memlens(args: argparse.Namespace) -> int:
     return rc
 
 
+def _cmd_ckpt(args: argparse.Namespace) -> int:
+    import os
+
+    from saturn_tpu.utils import checkpoint as ckpt_mod
+
+    if not os.path.isdir(args.path):
+        print(f"{args.path!r} is not a directory", file=sys.stderr)
+        return 2
+    try:
+        summary = ckpt_mod.summarize_dir(args.path)
+    except OSError as e:
+        print(f"cannot inspect {args.path!r}: {e}", file=sys.stderr)
+        return 2
+    bad = [c for c in summary["checkpoints"] if not c.get("ok")]
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+        return 1 if bad else 0
+    print(f"{summary['dir']}: {len(summary['checkpoints'])} checkpoint(s), "
+          f"{summary['total_bytes']} bytes on disk")
+    for c in summary["checkpoints"]:
+        name = os.path.basename(c["path"])
+        if c["format"] == "sharded-manifest":
+            print(f"  {name}: sharded manifest gen {c['generation']} — "
+                  f"{c['leaves']} leaves in {c['shards']} shard(s) across "
+                  f"{c['shard_files']} file(s), {c['bytes']} bytes, "
+                  f"pspec {c['pspec_fingerprint']}, "
+                  f"{'ok' if c['ok'] else 'CORRUPT/PARTIAL'}")
+        else:
+            print(f"  {name}: legacy single-file npz — {c['leaves']} "
+                  f"arrays, {c['bytes']} bytes, "
+                  f"{'ok' if c['ok'] else 'CORRUPT'}")
+    if summary["corrupt_sidecars"]:
+        print(f"  quarantined sidecars: "
+              + ", ".join(summary["corrupt_sidecars"]))
+    if summary["orphan_shards"]:
+        print(f"  orphan shard files (no manifest references them): "
+              + ", ".join(summary["orphan_shards"]))
+    return 1 if bad else 0
+
+
 def _percentile(values, q: float) -> float:
     xs = sorted(values)
     if not xs:
@@ -547,6 +591,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="also print per-technique peak/persistent/"
                         "transient byte splits")
     m.set_defaults(fn=_cmd_memlens)
+
+    k = sub.add_parser(
+        "ckpt",
+        help="inspect a checkpoint directory: per-manifest shard counts, "
+             "bytes, pspec fingerprint, corrupt sidecars and orphan shards",
+    )
+    k.add_argument("path", metavar="DIR")
+    k.set_defaults(fn=_cmd_ckpt)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -229,8 +229,11 @@ class Task:
         return _ckpt.exists(self.ckpt_path)
 
     def clear_ckpt(self) -> None:
-        if self.has_ckpt():
-            os.unlink(self.ckpt_path)
+        from saturn_tpu.utils import checkpoint as _ckpt
+
+        # delete removes the manifest AND its shard files (sharded format),
+        # joining any in-flight async save first.
+        _ckpt.delete(self.ckpt_path)
 
     # -------------------------------------------------------------- schedule
     def reconfigure(self, batch_count: int) -> None:

@@ -231,10 +231,14 @@ def reconcile_checkpoints(
     """Verify journaled checkpoint publications against the disk.
 
     For each task, walk its publications newest-first: a checkpoint that is
-    missing is skipped, one that fails its archive checksum
-    (``checkpoint.verify``) is quarantined to ``*.corrupt``, and the newest
-    *valid* one wins — recovery falls back to the previous durable
-    publication rather than dying on a torn write. Returns
+    missing is skipped, one that fails verification is quarantined to
+    ``*.corrupt``, and the newest *valid* one wins — recovery falls back to
+    the previous durable publication rather than dying on a torn write.
+    ``checkpoint.verify`` covers both formats: for a sharded manifest it
+    checks the manifest checksum AND that every referenced shard file
+    exists, is a sound archive, and together the shards cover each leaf
+    (a partial shard set from a mid-write crash fails here); for a legacy
+    single-file archive it checks the zip CRCs. Returns
     ``{task: authoritative path or None}``.
     """
     import os

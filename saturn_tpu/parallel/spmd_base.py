@@ -289,6 +289,13 @@ class SPMDTechnique(BaseTechnique):
 
         self._bundles: "OrderedDict[Any, _Bundle]" = OrderedDict()
         self._bundles_lock = threading.Lock()
+        # Static per-step FLOPs (shardflow's dense-dot ledger) per bundle
+        # key — the numerator of the task_interval tflops/mfu report.
+        # Traced lazily at most once per compiled program; a failed trace
+        # caches None so telemetry degrades to omitting the fields instead
+        # of re-paying (or re-raising) the trace every interval.
+        self._flops_cache: Dict[Any, Optional[float]] = {}
+        self._flops_lock = threading.Lock()
         # Why each (task, size) search came back infeasible — consumed (and
         # popped) by the trial runner's monotone pruning. Keyed per grid
         # point because one instance serves concurrent trial threads.
@@ -320,6 +327,31 @@ class SPMDTechnique(BaseTechnique):
         with self._bundles_lock:
             for key in [k for k in self._bundles if k[0] == task_name]:
                 del self._bundles[key]
+        with self._flops_lock:
+            for key in [k for k in self._flops_cache if k[0] == task_name]:
+                del self._flops_cache[key]
+
+    def _step_flops(self, task, devices, config) -> Optional[float]:
+        """Shardflow's static dense-FLOP count for one step of this (task,
+        config, block) — global across the sub-mesh, per batch. Cached per
+        bundle key (same identity as the compiled program it describes)."""
+        key = self._bundle_key(task, devices, config)
+        with self._flops_lock:
+            if key in self._flops_cache:
+                return self._flops_cache[key]
+        flops: Optional[float]
+        try:
+            from saturn_tpu.analysis.shardflow.interp import interpret
+
+            traced = self.trace_step(task, devices, config)
+            flops = float(interpret(traced).flops) or None
+        except Exception:
+            log.debug("shardflow flops trace failed for task %s", task.name,
+                      exc_info=True)
+            flops = None
+        with self._flops_lock:
+            self._flops_cache[key] = flops
+        return flops
 
     def _bundle_key(self, task, devices, config):
         return (
@@ -1014,20 +1046,27 @@ class SPMDTechnique(BaseTechnique):
             # *switches* technique or block between intervals).
             state = live[1]
         elif task.has_ckpt():
-            # Resume — restore host arrays and place them under THIS
-            # technique's shardings (cross-technique resharding; the
-            # reference's kill-and-respawn reload, ``FSDP.py:189-191``).
+            # Resume — map saved shards directly onto THIS technique's
+            # shardings (cross-technique resharding; the reference's
+            # kill-and-respawn reload, ``FSDP.py:189-191``). restore_sharded
+            # assembles each leaf lazily per destination shard from the
+            # manifest, so resume never materializes a full replicated host
+            # tree (and legacy single-file checkpoints take its compat path).
             from saturn_tpu.core import distributed as _dist
 
-            host_state = ckpt.restore(task.ckpt_path, bundle.state_shapes)
-            state = _dist.put_tree_global(host_state, bundle.state_shardings)
+            state = ckpt.restore_sharded(
+                task.ckpt_path, bundle.state_shapes, bundle.state_shardings
+            )
             # Data cursor is derived from the trained-step count, so resume
             # is restart-safe (the reference replayed the iterator from the
             # in-memory cursor only, ``Task.py:130-140``).
             # cursor_for_step folds the quarantine skip-list into the
             # modulus, so a restore after quarantine replay lands on the
             # surviving sequence.
-            task.current_batch = task.cursor_for_step(int(host_state["step"]))
+            step_leaf = state["step"]
+            task.current_batch = task.cursor_for_step(
+                int(np.asarray(_dist.host_array(step_leaf)))
+            )
         else:
             state = bundle.init()
 
@@ -1259,11 +1298,31 @@ class SPMDTechnique(BaseTechnique):
                     # still a clean sample — without it a task scheduled one
                     # batch per interval never gets corrected.
                     task.note_realized_per_batch(per_batch)
+            # Achieved TFLOP/s + MFU for this interval: shardflow's static
+            # per-step FLOP count (cached per compiled program) over the
+            # measured window wall time, normalized by the block's aggregate
+            # peak. Self-reports every run against the prior's 0.45 MFU
+            # target without a bench run; omitted when the step can't be
+            # traced (fields are additive, consumers treat them as optional).
+            perf = {}
+            if _metrics.enabled():
+                step_flops = self._step_flops(task, devices, config)
+                if step_flops:
+                    from saturn_tpu.analysis.shardflow.prior import (
+                        hardware_model,
+                    )
+
+                    achieved = step_flops * n / max(elapsed_all, 1e-9)
+                    peak = hardware_model()["peak_flops"]
+                    perf["tflops"] = round(achieved / 1e12, 4)
+                    perf["mfu"] = round(
+                        achieved / (max(len(devices), 1) * peak), 6
+                    )
             _metrics.event(
                 "task_interval", task=task.name, technique=self.name,
                 batches=n, loss=loss_val, samples_per_sec=round(sps, 2),
                 per_batch_s=per_batch, window=k, fused_windows=n_windows,
-                coscheduled=bool(shared),
+                coscheduled=bool(shared), **perf,
             )
             log.info("task %s [%s]: ran %d batches (K=%d, %d fused windows), "
                      "loss %.4f, %.1f samples/s",

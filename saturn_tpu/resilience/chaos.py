@@ -201,18 +201,24 @@ def compare_checkpoints(
 ) -> List[str]:
     """Byte-for-byte comparison of final published checkpoints.
 
-    Compares ``{name}.npz`` under both directories (all common ``.npz``
-    stems when ``names`` is None) array-by-array on the raw buffer — the
-    bit-identity the campaign promises, strict enough to catch a single
-    flipped mantissa bit and NaN-safe (``==`` is not). Returns a list of
-    human-readable mismatch descriptions; empty means identical.
+    Compares ``{name}.npz`` checkpoints under both directories (all common
+    stems when ``names`` is None; per-rank shard files and quarantine
+    sidecars are not themselves checkpoints and are skipped) array-by-array
+    on the raw buffer — the bit-identity the campaign promises, strict
+    enough to catch a single flipped mantissa bit and NaN-safe (``==`` is
+    not). Reads through ``checkpoint.load_arrays`` so sharded-manifest and
+    legacy single-file checkpoints compare interchangeably. Returns a list
+    of human-readable mismatch descriptions; empty means identical.
     """
-    import numpy as np
+    from saturn_tpu.utils import checkpoint as ckpt
+    from saturn_tpu.utils.checkpoint import _SHARD_RE
 
     if names is None:
         stems = sorted(
             os.path.splitext(f)[0]
-            for f in os.listdir(dir_a) if f.endswith(".npz")
+            for f in os.listdir(dir_a)
+            if f.endswith(".npz") and ".corrupt" not in f
+            and not _SHARD_RE.search(f)
         )
     else:
         stems = list(names)
@@ -223,20 +229,21 @@ def compare_checkpoints(
         if not os.path.exists(pb):
             mismatches.append(f"{stem}: missing from {dir_b}")
             continue
-        with np.load(pa) as a, np.load(pb) as b:
-            ka, kb = set(a.files), set(b.files)
-            if ka != kb:
+        a = ckpt.load_arrays(pa)
+        b = ckpt.load_arrays(pb)
+        ka, kb = set(a), set(b)
+        if ka != kb:
+            mismatches.append(
+                f"{stem}: key sets differ ({sorted(ka ^ kb)})"
+            )
+            continue
+        for k in sorted(ka):
+            va, vb = a[k], b[k]
+            if va.shape != vb.shape or va.dtype != vb.dtype:
                 mismatches.append(
-                    f"{stem}: key sets differ ({sorted(ka ^ kb)})"
+                    f"{stem}[{k}]: shape/dtype {va.shape}/{va.dtype} "
+                    f"vs {vb.shape}/{vb.dtype}"
                 )
-                continue
-            for k in sorted(ka):
-                va, vb = a[k], b[k]
-                if va.shape != vb.shape or va.dtype != vb.dtype:
-                    mismatches.append(
-                        f"{stem}[{k}]: shape/dtype {va.shape}/{va.dtype} "
-                        f"vs {vb.shape}/{vb.dtype}"
-                    )
-                elif va.tobytes() != vb.tobytes():
-                    mismatches.append(f"{stem}[{k}]: bytes differ")
+            elif va.tobytes() != vb.tobytes():
+                mismatches.append(f"{stem}[{k}]: bytes differ")
     return mismatches

@@ -46,7 +46,12 @@ logger = logging.getLogger("saturn_tpu")
 #: ``post-rollback`` is crossed by the health guardian's recovery path right
 #: after a faulted task was rolled back (its quarantine/detach records are
 #: already durable — the chaos campaign kills here to prove replay restores
-#: them).
+#: them). The last two are the sharded checkpoint writer's commit edges
+#: (``utils/checkpoint.set_crash_barrier``): ``mid-shard-write`` — shard
+#: bytes staged, the shard rename not yet done — and ``pre-manifest-rename``
+#: — every shard durable, the manifest (the commit point) not yet renamed.
+#: A kill at either must leave the previously published generation fully
+#: restorable.
 KILL_POINTS = (
     "pre-commit",
     "mid-fsync",
@@ -56,6 +61,8 @@ KILL_POINTS = (
     "mid-interval",
     "post-checkpoint",
     "post-rollback",
+    "mid-shard-write",
+    "pre-manifest-rename",
 )
 
 

@@ -3,25 +3,49 @@
 The reference checkpoints model state only, via ``torch.save`` of a state dict
 (``Task.py:150-169``), and silently drops optimizer state between intervals
 (``FSDP.py:220``, ``DDP.py:163``) — a wart SURVEY.md §5 flags to fix. Here we
-save the **full train state** (params + optimizer state + step) as host numpy
-arrays keyed by their tree path; the data cursor is derived from ``step`` on
-restore, making resume restart-safe.
+save the **full train state** (params + optimizer state + step) keyed by tree
+path; the data cursor is derived from ``step`` on restore, making resume
+restart-safe.
+
+Format (round 19, ROADMAP item 6): **sharded manifest**. The logical
+checkpoint path holds a checksummed JSON manifest (tree structure, leaf
+dtypes/shapes, per-leaf shard index→file map, PartitionSpec fingerprint);
+the array bytes live beside it in per-rank ``.npz`` shard files named
+``<path>.g<GEN>.r<RANK>.npz``. Each process writes only its
+locally-addressable shards — the device→host copy is a pure local transfer,
+with **no allgather and no replication funnel** (the SAT-X002 anti-pattern
+the previous single-writer format needed two sanction markers for). The
+global shard layout is computed from sharding *metadata* alone
+(``Sharding.devices_indices_map`` is the same on every process), so the
+manifest needs no communication either. ``GEN`` is a per-save generation id:
+a crashed save can never tear the previously committed generation's files,
+and the manifest rename is the single atomic commit point (stale generations
+are garbage-collected only after it lands).
 
 Saving by *path* rather than pickling tree structure is what makes
-interval-boundary **technique switching** work (the reference's central trick,
-``executor.py:65`` kill-and-respawn + state-dict reload): any technique can
-restore the same arrays under a *different* mesh/sharding, because restore maps
-host arrays onto a freshly-initialized template state and the caller then
-``device_put``s them with its own sharding.
+interval-boundary **technique switching** work (the reference's central
+trick, ``executor.py:65`` kill-and-respawn + state-dict reload): any
+technique can restore the same arrays under a *different* mesh/sharding,
+because ``restore_sharded`` maps saved shards onto the destination
+technique's shardings leaf by leaf — assembling only the blocks each
+destination device needs, so no host materializes the full replicated tree.
+A compatibility reader keeps pre-round-19 single-file ``.npz`` checkpoints
+restorable (readers sniff JSON-vs-zip on the first byte).
 """
 
 from __future__ import annotations
 
+import glob
+import hashlib
+import json
 import logging
 import os
+import re
 import tempfile
 import threading
-from typing import Any, Dict
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -30,13 +54,21 @@ from saturn_tpu.utils.treepath import path_str as _path_str
 
 log = logging.getLogger("saturn_tpu")
 
+#: Manifest self-identification; readers sniff the first byte (``{`` vs
+#: zip's ``PK``) and then check this field.
+MANIFEST_FORMAT = "saturn-ckpt-manifest"
+MANIFEST_VERSION = 1
+
+#: Shard files committed beside a manifest: ``<path>.g<GEN>.r<RANK>.npz``.
+_SHARD_RE = re.compile(r"\.g([0-9a-f]+)\.r(\d+)\.npz$")
+
 
 class CheckpointCorruptError(RuntimeError):
     """A checkpoint exists on disk but cannot be read back (truncated write,
-    bit rot, torn page). The unreadable file has already been quarantined to
-    a ``*.corrupt`` sidecar by the time this raises, so crash recovery can
-    fall back to the *previous* published checkpoint instead of dying on
-    the newest one."""
+    bit rot, torn page, missing/corrupt shard file). The unreadable artifact
+    has already been quarantined to a ``*.corrupt`` sidecar by the time this
+    raises, so crash recovery can fall back to the *previous* published
+    checkpoint instead of dying on the newest one."""
 
     def __init__(self, path: str, quarantined: str, cause: str):
         self.path = path
@@ -66,14 +98,99 @@ def quarantine(path: str) -> str:
     return sidecar
 
 
+# ------------------------------------------------------------ crash barriers
+# The resilience crash harness installs a callback here to simulate SIGKILL
+# at the two commit-critical crossings of a sharded save: ``mid-shard-write``
+# (shard bytes staged, shard rename not yet done) and ``pre-manifest-rename``
+# (all shards durable, manifest — the commit point — not yet renamed). A
+# kill at either leaves the previous generation fully intact.
+_CRASH_BARRIER: Optional[Callable[[str, Dict[str, Any]], None]] = None
+
+
+def set_crash_barrier(cb: Optional[Callable[[str, Dict[str, Any]], None]]) -> None:
+    """Install (None to clear) the crash-harness barrier callback; called as
+    ``cb(point, ctx)`` from whichever thread performs the write."""
+    global _CRASH_BARRIER
+    _CRASH_BARRIER = cb
+
+
+def _barrier(point: str, **ctx: Any) -> None:
+    cb = _CRASH_BARRIER
+    if cb is not None:
+        cb(point, ctx)
+
+
+# ---------------------------------------------------------------- sniff/read
+def _is_manifest_file(path: str) -> bool:
+    """Format sniff: a round-19 manifest is JSON (first byte ``{``); the
+    legacy single-file format is a zip (``PK``). Raises OSError for a path
+    that cannot be opened — callers decide how missing files surface."""
+    with open(path, "rb") as f:
+        return f.read(1) == b"{"
+
+
+def _manifest_checksum(body: Dict[str, Any]) -> str:
+    """CRC-32 of the canonical (sorted-key, no-whitespace) JSON body with the
+    ``checksum`` field absent — a torn or hand-edited manifest fails closed."""
+    scrubbed = {k: v for k, v in body.items() if k != "checksum"}
+    canon = json.dumps(scrubbed, sort_keys=True, separators=(",", ":"))
+    return f"{zlib.crc32(canon.encode('utf-8')) & 0xFFFFFFFF:08x}"
+
+
+def _read_manifest(path: str) -> Dict[str, Any]:
+    """Parse + integrity-check a manifest. Raises ``ValueError`` on any
+    structural or checksum mismatch (callers wrap into quarantine)."""
+    with open(path, "r", encoding="utf-8") as f:
+        body = json.load(f)
+    if body.get("format") != MANIFEST_FORMAT:
+        raise ValueError(f"not a {MANIFEST_FORMAT} file")
+    if int(body.get("version", -1)) > MANIFEST_VERSION:
+        raise ValueError(f"manifest version {body['version']} is newer than "
+                         f"this reader ({MANIFEST_VERSION})")
+    want = body.get("checksum")
+    got = _manifest_checksum(body)
+    if want != got:
+        raise ValueError(f"manifest checksum mismatch ({want} != {got})")
+    return body
+
+
 def verify(path: str) -> bool:
-    """Integrity-check a published ``.npz`` checkpoint without loading it
-    into memory: the zip central directory must parse and every member's
-    stored CRC-32 must match its payload (``testzip`` streams each entry).
-    False for missing, truncated or corrupt files — never raises."""
+    """Integrity-check a published checkpoint without loading it into
+    memory. Manifest format: the JSON body must checksum, every referenced
+    shard file must exist, parse as a zip with every member CRC intact, and
+    contain the referenced member keys; every leaf's shard extents must
+    cover its full shape. Legacy ``.npz``: the zip central directory must
+    parse and every member CRC must match. False for missing, truncated,
+    partial or corrupt checkpoints — never raises."""
     import zipfile
 
     try:
+        if _is_manifest_file(path):
+            m = _read_manifest(path)
+            d = os.path.dirname(os.path.abspath(path))
+            members: Dict[str, set] = {}
+            for entry in m["leaves"].values():
+                covered = 0
+                for sh in entry["shards"]:
+                    members.setdefault(sh["file"], set()).add(sh["key"])
+                    n = 1
+                    for start, stop in sh["index"]:
+                        n *= max(int(stop) - int(start), 0)
+                    covered += n
+                total = 1
+                for dim in entry["shape"]:
+                    total *= int(dim)
+                if covered != total:
+                    return False  # partial shard set (torn save)
+            for fname, keys in members.items():
+                fpath = os.path.join(d, fname)
+                with zipfile.ZipFile(fpath) as zf:
+                    if zf.testzip() is not None:
+                        return False
+                    have = {os.path.splitext(n)[0] for n in zf.namelist()}
+                    if not keys <= have:
+                        return False
+            return True
         with zipfile.ZipFile(path) as zf:
             return zf.testzip() is None
     except Exception:
@@ -81,9 +198,10 @@ def verify(path: str) -> bool:
 
 
 # Publication hooks: called as ``hook(task_or_stem, path)`` after the atomic
-# rename lands a checkpoint, from whichever thread performed the write (the
-# async writer thread for ``save_async``). The durability layer registers one
-# to journal every publication; hooks must be cheap and must not raise.
+# manifest rename lands a checkpoint, from whichever thread performed the
+# write (the async writer thread for ``save_async``). The durability layer
+# registers one to journal every publication; hooks must be cheap and must
+# not raise.
 _PUBLISH_HOOKS: list = []
 
 
@@ -110,12 +228,12 @@ def _notify_published(path: str) -> None:
 
 
 def _writer_rank(tree: Any) -> int:
-    """The process that writes this tree: the lowest process index that
-    addresses its arrays. For a cross-host sharded/replicated state that is
-    the coordinator; for a state living entirely on one host's devices it
-    is that host (the coordinator never even sees the tree — the multi-host
-    engine only calls execute() on processes local to the task's block).
-    Host-only trees (plain numpy) default to rank 0."""
+    """The process that writes this tree's *manifest*: the lowest process
+    index that addresses its arrays. For a cross-host sharded/replicated
+    state that is the coordinator; for a state living entirely on one host's
+    devices it is that host (the coordinator never even sees the tree — the
+    multi-host engine only calls execute() on processes local to the task's
+    block). Host-only trees (plain numpy) default to rank 0."""
     for leaf in jax.tree_util.tree_leaves(tree):
         ds = getattr(getattr(leaf, "sharding", None), "device_set", None)
         if ds:
@@ -131,81 +249,199 @@ def _should_write(tree: Any) -> bool:
     return distributed.process_index() == _writer_rank(tree)
 
 
-def flatten_to_host(tree: Any) -> Dict[str, np.ndarray]:
-    """Flatten a (possibly sharded, device-resident) pytree to host numpy.
+def _my_rank() -> int:
+    from saturn_tpu.core import distributed
 
-    Multi-host: a leaf sharded across processes is not fully addressable —
-    ``device_get`` would raise — so it is allgathered first (every process
-    pays the gather; only the coordinator writes, see ``save_async``)."""
-    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-    out: Dict[str, np.ndarray] = {}
-    for path, leaf in flat:
-        key = _path_str(path)
-        if key in out:
+    return distributed.process_index() if distributed.is_multihost() else 0
+
+
+def _stored(arr: np.ndarray) -> np.ndarray:
+    # npz can't round-trip ml_dtypes (bfloat16/fp8); widen to float32 —
+    # restore() narrows back to the template's dtype.
+    if (arr.dtype.kind == "V" or "bfloat16" in str(arr.dtype)
+            or "float8" in str(arr.dtype)):
+        return arr.astype(np.float32)
+    return arr
+
+
+def _norm_index(index: Tuple, shape: Tuple[int, ...]) -> Tuple[Tuple[int, int], ...]:
+    """Resolve a ``devices_indices_map`` slice tuple against ``shape`` into
+    concrete ``(start, stop)`` extents — the manifest's shard coordinates."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _pspec_fingerprint(tree: Any) -> str:
+    """Stable digest of the tree's per-leaf partition specs — lets restore
+    and the ``analysis ckpt`` CLI tell at a glance whether a checkpoint was
+    written under the same layout (purely informational: restore reshards
+    onto the destination regardless)."""
+    items = []
+    for p, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        items.append([_path_str(p), "host" if spec is None else str(spec)])
+    canon = json.dumps(sorted(items), separators=(",", ":"))
+    return hashlib.sha1(canon.encode("utf-8")).hexdigest()[:12]
+
+
+class _Snapshot:
+    """The synchronous half of a save: the global shard plan (manifest body)
+    plus this process's shard payloads, already on host. Building one is the
+    only part that touches devices — and only via local per-shard
+    device→host copies (``shard.data``), never a gather."""
+
+    __slots__ = ("manifest", "local", "rank", "gen", "writes_manifest")
+
+    def __init__(self, manifest: Dict[str, Any], local: Dict[str, np.ndarray],
+                 rank: int, gen: str, writes_manifest: bool):
+        self.manifest = manifest
+        self.local = local
+        self.rank = rank
+        self.gen = gen
+        self.writes_manifest = writes_manifest
+
+
+def _snapshot(path: str, tree: Any) -> _Snapshot:
+    gen = f"{time.time_ns():x}"
+    rank = _my_rank()
+    wrank = _writer_rank(tree)
+    base = os.path.basename(path)
+    leaves: Dict[str, Any] = {}
+    local: Dict[str, np.ndarray] = {}
+    # which ranks own at least one shard — their files must exist on restore
+    for tpath, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _path_str(tpath)
+        if key in leaves:
             raise ValueError(f"duplicate tree path key: {key!r}")
-        if (
-            hasattr(leaf, "is_fully_addressable")
-            and not leaf.is_fully_addressable
-        ):
-            # Replicate over the leaf's OWN mesh — a transfer involving
-            # exactly the processes that address it (all of which call
-            # save, since the engine runs execute() on every block-local
-            # rank). A cluster-wide allgather here would hang processes
-            # that are not part of this task's block on 3+ host clusters.
-            # device_put (not a per-leaf jit identity) so repeated saves
-            # don't retrace/compile hundreds of leaves on the interval-end
-            # critical path.
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            mesh = getattr(leaf.sharding, "mesh", None)
-            if mesh is not None:
-                # sanctioned-shardflow: single-writer npz checkpoint needs
-                # the whole leaf on one host; gather is bounded to the
-                # leaf's own mesh and runs once per save, off the step hot
-                # loop. Removing the funnel entirely is ROADMAP item 6's
-                # sharded checkpoint I/O (per-host shard files).
-                rep = jax.device_put(
-                    leaf, NamedSharding(mesh, PartitionSpec())
+        sharding = getattr(leaf, "sharding", None)
+        shape = tuple(int(s) for s in getattr(leaf, "shape", np.shape(leaf)))
+        dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        shards: List[Dict[str, Any]] = []
+        if sharding is not None and hasattr(sharding, "devices_indices_map"):
+            # Global layout from metadata alone: devices_indices_map is
+            # identical on every process, so each rank derives the same
+            # plan with zero communication. Replicas dedupe to one owner
+            # (lowest (process, device id)) so each block is written once.
+            groups: Dict[Tuple, list] = {}
+            for dev, index in sharding.devices_indices_map(shape).items():
+                groups.setdefault(_norm_index(index, shape), []).append(dev)
+            by_dev_id = {
+                s.device.id: s for s in getattr(leaf, "addressable_shards", [])
+            }
+            stored_dtype = None
+            for i, extent in enumerate(sorted(groups)):
+                owner = min(
+                    groups[extent],
+                    key=lambda d: (getattr(d, "process_index", 0),
+                                   getattr(d, "id", 0)),
                 )
-                leaf = rep.addressable_data(0)
-            else:  # non-mesh sharding: fall back to the global gather
-                from jax.experimental import multihost_utils
+                orank = getattr(owner, "process_index", 0)
+                member = f"{key}#s{i}"
+                shards.append({
+                    "index": [[a, b] for a, b in extent],
+                    "file": f"{base}.g{gen}.r{orank}.npz",
+                    "key": member,
+                })
+                if orank == rank:
+                    dshard = by_dev_id[getattr(owner, "id", 0)]
+                    arr = _stored(np.asarray(jax.device_get(dshard.data)))
+                    local[member] = arr
+                    stored_dtype = str(arr.dtype)
+            if stored_dtype is None:  # no local shard: derive, don't copy
+                widened = "bfloat16" in dtype or "float8" in dtype
+                stored_dtype = "float32" if widened else str(np.dtype(dtype))
+        else:
+            # Host (plain numpy / python scalar) leaf: one full-extent
+            # shard, written by the tree's writer rank.
+            arr = _stored(np.asarray(leaf))
+            member = f"{key}#s0"
+            shards.append({
+                "index": [[0, d] for d in shape],
+                "file": f"{base}.g{gen}.r{wrank}.npz",
+                "key": member,
+            })
+            stored_dtype = str(arr.dtype)
+            if rank == wrank:
+                local[member] = arr
+        leaves[key] = {
+            "shape": list(shape),
+            "dtype": dtype,
+            "stored_dtype": stored_dtype,
+            "shards": shards,
+        }
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "version": MANIFEST_VERSION,
+        "generation": gen,
+        "pspec_fingerprint": _pspec_fingerprint(tree),
+        "leaves": leaves,
+    }
+    return _Snapshot(manifest, local, rank, gen, rank == wrank)
 
-                # sanctioned-shardflow: rare non-mesh-sharding fallback for
-                # the same single-writer save path; superseded by ROADMAP
-                # item 6's sharded checkpoint I/O.
-                leaf = multihost_utils.process_allgather(leaf, tiled=True)
-        arr = np.asarray(jax.device_get(leaf))
-        # npz can't round-trip ml_dtypes (bfloat16/fp8); widen to float32 —
-        # restore() narrows back to the template's dtype.
-        if arr.dtype.kind == "V" or "bfloat16" in str(arr.dtype) or "float8" in str(arr.dtype):
-            arr = arr.astype(np.float32)
-        out[key] = arr
-    return out
+
+def _gc_stale_generations(path: str, keep_gen: str) -> None:
+    """After the manifest rename lands, older generations' shard files are
+    unreachable — remove them (best-effort; a crash here only leaks disk,
+    never correctness)."""
+    for f in glob.glob(glob.escape(path) + ".g*.npz"):
+        m = _SHARD_RE.search(f)
+        if m and m.group(1) != keep_gen:
+            try:
+                os.unlink(f)
+            except OSError:
+                log.warning("could not GC stale checkpoint shard %s", f)
 
 
-def _write_atomic(path: str, arrays: Dict[str, np.ndarray]) -> None:
+def _commit_snapshot(path: str, snap: _Snapshot) -> None:
+    """The disk half of a save: stage + rename this rank's shard file, then
+    (manifest writer only) stage + rename the manifest — the atomic commit
+    point — and notify publication. Crash-barrier crossings bracket both
+    renames; a kill at either leaves the previous generation untouched."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
+    if snap.local:
+        fname = os.path.join(d, f"{os.path.basename(path)}"
+                                f".g{snap.gen}.r{snap.rank}.npz")
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **snap.local)
+            _barrier("mid-shard-write", path=fname, tmp=tmp, gen=snap.gen)
+            os.replace(tmp, fname)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    if not snap.writes_manifest:
+        return
+    body = dict(snap.manifest)
+    body["checksum"] = _manifest_checksum(body)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(body, f, separators=(",", ":"))
+        _barrier("pre-manifest-rename", path=path, tmp=tmp, gen=snap.gen)
         os.replace(tmp, path)  # atomic: no torn checkpoints on crash
         _notify_published(path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    _gc_stale_generations(path, snap.gen)
 
 
 def save(path: str, tree: Any) -> None:
-    """Atomically write a pytree checkpoint to ``path`` (an ``.npz`` file).
-    Multi-host: collective gather on every participating rank; the write
-    happens on the tree's writer rank only (see ``_writer_rank``)."""
-    should = _should_write(tree)
-    arrays = flatten_to_host(tree)
-    if should:
-        _write_atomic(path, arrays)
+    """Atomically write a sharded pytree checkpoint rooted at ``path``.
+
+    Each process pulls only its locally-addressable shards to host (no
+    collective of any kind) and writes them to its own generation-tagged
+    shard file; the tree's writer rank additionally commits the manifest.
+    The manifest rename is the commit point — a crash at any earlier moment
+    leaves the previously published checkpoint fully readable."""
+    snap = _snapshot(path, tree)
+    _commit_snapshot(path, snap)
 
 
 # --------------------------------------------------------------- async writes
@@ -234,36 +470,48 @@ def _wait_pending(path: str) -> None:
         raise RuntimeError(f"async checkpoint write to {path} failed") from err
 
 
+def _record_async_failure(key: str, path: str, err: BaseException) -> None:
+    """Park a background-write failure for the next join point. Keep-first:
+    if an earlier failure for this path is still unconsumed, the new one is
+    logged and dropped — the first error is the root cause a join point
+    must surface (the engine's ``_record_error(keep_first)`` convention)."""
+    with _PENDING_LOCK:
+        prev = _FAILED.get(key)
+        if prev is not None:
+            log.warning(
+                "async checkpoint write to %s failed again (%r); keeping "
+                "first error %r", path, err, prev,
+            )
+        else:
+            _FAILED[key] = err
+
+
 def save_async(path: str, tree: Any) -> None:
     """``save`` with the disk write off the critical path.
 
-    Blocks only for the device->host transfer (``flatten_to_host``); the
-    ``np.savez`` + atomic rename happens in a background thread. A crash
-    mid-write leaves the previous checkpoint intact (same atomicity as
-    ``save``). ``flush()`` joins all outstanding writes; a failed write
-    re-raises from the next join point on the same path (or ``flush``).
+    Blocks only for the local device->host shard transfer (``_snapshot``);
+    the shard + manifest writes and atomic renames happen in a background
+    thread. A crash mid-write leaves the previous checkpoint intact (same
+    commit discipline as ``save``). ``flush()`` joins all outstanding
+    writes; a failed write re-raises from the next join point on the same
+    path (or ``flush``).
 
-    Multi-host: every participating process joins the device->host gather
-    (a collective for cross-host arrays), but only the tree's writer rank
-    (``_writer_rank`` — lowest process addressing it) touches the
-    filesystem; N processes racing one atomic rename on shared storage
-    would be wasted I/O at best. The multi-host engine flushes + barriers
-    at interval end so readers never race the write (``engine.py``).
-    """
+    Multi-host: every participating process snapshots its OWN shards (pure
+    local copies — the sharded format removed the old collective gather)
+    and writes its own shard file; only the tree's writer rank
+    (``_writer_rank`` — lowest process addressing it) commits the manifest.
+    The multi-host engine flushes + barriers at interval end so readers
+    never race the write (``engine.py``)."""
     _wait_pending(path)  # at most one in-flight write per path
-    should = _should_write(tree)
-    arrays = flatten_to_host(tree)
-    if not should:
-        return
+    snap = _snapshot(path, tree)
     key = os.path.abspath(path)
 
     def write():
         try:
-            _write_atomic(path, arrays)
+            _commit_snapshot(path, snap)
         except BaseException as e:  # re-raised at the next join point
             log.exception("async checkpoint write to %s failed", path)
-            with _PENDING_LOCK:
-                _FAILED[key] = e
+            _record_async_failure(key, path, e)
         finally:
             with _PENDING_LOCK:
                 if _PENDING.get(key) is threading.current_thread():
@@ -288,6 +536,124 @@ def flush() -> None:
         raise RuntimeError(f"async checkpoint write to {path} failed") from err
 
 
+# -------------------------------------------------------------------- restore
+class _ShardReader:
+    """Lazily-opened shard files for one manifest; at most one ``NpzFile``
+    per shard file stays open, so assembly is O(one leaf) of extra host
+    memory, never the full tree."""
+
+    def __init__(self, path: str):
+        self._dir = os.path.dirname(os.path.abspath(path))
+        self._open: Dict[str, Any] = {}
+
+    def member(self, fname: str, key: str) -> np.ndarray:
+        npz = self._open.get(fname)
+        if npz is None:
+            npz = np.load(os.path.join(self._dir, fname))
+            self._open[fname] = npz
+        return npz[key]
+
+    def close(self) -> None:
+        for npz in self._open.values():
+            try:
+                npz.close()
+            except Exception:
+                pass
+        self._open.clear()
+
+
+def _assemble_block(entry: Dict[str, Any], reader: _ShardReader,
+                    block: Tuple[Tuple[int, int], ...],
+                    dtype: Any) -> np.ndarray:
+    """Materialize one hyper-rectangular block of a leaf from its shards
+    (the lazy per-shard assembly ``restore_sharded`` builds device arrays
+    from). ``block`` is concrete ``(start, stop)`` extents; a block exactly
+    matching one source shard is returned without a copy beyond the dtype
+    cast."""
+    shape = tuple(bl[1] - bl[0] for bl in block)
+    for sh in entry["shards"]:
+        if tuple((int(a), int(b)) for a, b in sh["index"]) == block:
+            arr = reader.member(sh["file"], sh["key"]).astype(dtype, copy=False)
+            # NOT ascontiguousarray: that helper promotes 0-d to 1-d,
+            # breaking scalar leaves like ``step``; npz members are
+            # already contiguous.
+            return arr
+    out = np.empty(shape, dtype=dtype)
+    covered = 0
+    for sh in entry["shards"]:
+        src_sel, dst_sel, n = [], [], 1
+        for (bs, be), (ss, se) in zip(block, sh["index"]):
+            lo, hi = max(bs, int(ss)), min(be, int(se))
+            if lo >= hi:
+                n = 0
+                break
+            src_sel.append(slice(lo - int(ss), hi - int(ss)))
+            dst_sel.append(slice(lo - bs, hi - bs))
+            n *= hi - lo
+        if n == 0:
+            continue
+        data = reader.member(sh["file"], sh["key"])
+        out[tuple(dst_sel)] = data[tuple(src_sel)].astype(dtype, copy=False)
+        covered += n
+    total = 1
+    for dim in shape:
+        total *= dim
+    if covered < total:
+        raise ValueError(
+            f"shard set does not cover requested block {block} "
+            f"({covered}/{total} elements)"
+        )
+    return out
+
+
+def _full_extent(shape) -> Tuple[Tuple[int, int], ...]:
+    return tuple((0, int(d)) for d in shape)
+
+
+def _load_manifest_arrays(path: str,
+                          manifest: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    reader = _ShardReader(path)
+    try:
+        out: Dict[str, np.ndarray] = {}
+        for key, entry in manifest["leaves"].items():
+            out[key] = _assemble_block(
+                entry, reader, _full_extent(entry["shape"]),
+                np.dtype(entry["stored_dtype"]),
+            )
+        return out
+    finally:
+        reader.close()
+
+
+def load_arrays(path: str) -> Dict[str, np.ndarray]:
+    """Read a checkpoint (either format) as a flat ``{tree/path: ndarray}``
+    dict of full host arrays in *stored* dtype (bf16/fp8 leaves come back
+    float32-widened, exactly as the legacy ``np.load`` view did) — the
+    drop-in replacement for code that used to ``np.load`` the checkpoint
+    file directly. Joins any in-flight async write; quarantines + raises
+    :class:`CheckpointCorruptError` on unreadable/partial checkpoints."""
+    _wait_pending(path)
+    # Absent is not corrupt: callers branch on exists(). Only the *root*
+    # file's absence means absent — a missing shard file below IS corruption
+    # (partial shard set) and takes the quarantine path.
+    is_manifest = _is_manifest_file(path)
+    try:
+        if is_manifest:
+            return _load_manifest_arrays(path, _read_manifest(path))
+        with np.load(path) as data:
+            return {k: data[k] for k in data.files}
+    except Exception as e:
+        # Truncated / torn / bit-rotted manifest or shard set: quarantine
+        # the checkpoint so the next reader (and crash recovery) falls back
+        # to the previous one instead of re-hitting the same unreadable
+        # file. Shard files of the quarantined generation are swept by the
+        # next successful save's GC.
+        sidecar = quarantine(path)
+        log.warning("checkpoint %s unreadable (%r); quarantined to %s",
+                    path, e, sidecar)
+        raise CheckpointCorruptError(path, sidecar, repr(e)) from e
+
+
 def restore(path: str, template: Any) -> Any:
     """Map saved arrays onto ``template``'s structure (host numpy leaves).
 
@@ -301,20 +667,7 @@ def restore(path: str, template: Any) -> Any:
     no collective here, because a task local to one host restores on that
     host alone and a cluster-wide barrier would deadlock.
     """
-    _wait_pending(path)  # an async save to this path may still be in flight
-    try:
-        with np.load(path) as data:
-            saved = {k: data[k] for k in data.files}
-    except FileNotFoundError:
-        raise  # absent is not corrupt: callers branch on exists()
-    except Exception as e:
-        # Truncated / torn / bit-rotted archive: quarantine it so the next
-        # reader (and crash recovery) falls back to the previous checkpoint
-        # instead of re-hitting the same unreadable file.
-        sidecar = quarantine(path)
-        log.warning("checkpoint %s unreadable (%r); quarantined to %s",
-                    path, e, sidecar)
-        raise CheckpointCorruptError(path, sidecar, repr(e)) from e
+    saved = load_arrays(path)
 
     def replace(tree_path, leaf):
         key = _path_str(tree_path)
@@ -334,31 +687,114 @@ def restore(path: str, template: Any) -> Any:
     return jax.tree_util.tree_map_with_path(replace, template)
 
 
+def _resolve_sharding(sharding: Any, template: Any):
+    """Normalize the three ``restore_sharded`` sharding forms into a
+    per-leaf callable ``(tree_path, shape_dtype) -> Sharding``."""
+    if isinstance(sharding, jax.sharding.Sharding):
+        # isinstance check FIRST: Sharding subclasses may be callable.
+        return lambda p, sds: sharding
+    if callable(sharding):
+        return sharding
+    by_key = {
+        _path_str(p): s
+        for p, s in jax.tree_util.tree_flatten_with_path(sharding)[0]
+    }
+    return lambda p, sds: by_key[_path_str(p)]
+
+
+def _place_leaf(entry: Dict[str, Any], reader: _ShardReader,
+                dst_sharding: Any, dtype: Any) -> Any:
+    """Build one destination device array from source shards, assembling
+    only the block each destination device actually needs. Falls back to
+    full-leaf host assembly + ``device_put`` for non-device memory kinds
+    (offloaded ``pinned_host`` state), where callback-placement support
+    varies by backend."""
+    shape = tuple(int(d) for d in entry["shape"])
+    mk = getattr(dst_sharding, "memory_kind", None)
+    if mk not in (None, "device"):
+        full = _assemble_block(entry, reader, _full_extent(shape), dtype)
+        return jax.device_put(full, dst_sharding)
+
+    def cb(index):
+        return _assemble_block(entry, reader, _norm_index(index, shape), dtype)
+
+    return jax.make_array_from_callback(shape, dst_sharding, cb)
+
+
 def restore_sharded(path: str, template: Any, sharding: Any) -> Any:
     """``restore`` + place every leaf on devices under ``sharding``.
 
     This is the cross-mesh migration primitive: a checkpoint written on one
     mesh shape restores onto a *different* one (half the devices after a
-    slice preemption, twice after a grow), because the npz holds full host
-    arrays keyed by tree path — nothing about the old mesh survives in the
-    file. ``sharding`` is one of:
+    slice preemption, twice after a grow), because the manifest holds
+    mesh-agnostic ``(start, stop)`` extents keyed by tree path — nothing
+    about the old mesh constrains the destination. For manifest checkpoints
+    each leaf is assembled lazily per destination shard
+    (``jax.make_array_from_callback``), so no host materializes the full
+    replicated tree; legacy single-file checkpoints take the compat
+    full-host path. ``sharding`` is one of:
 
     - a single ``jax.sharding.Sharding`` applied to every leaf (the common
       fully-replicated / uniform case),
     - a pytree of shardings matching ``template``'s structure,
-    - a callable ``(tree_path, host_leaf) -> Sharding`` for per-leaf rules.
+    - a callable ``(tree_path, leaf_like) -> Sharding`` for per-leaf rules
+      (``leaf_like`` has ``shape``/``dtype``/``ndim``).
     """
-    host = restore(path, template)
-    if isinstance(sharding, jax.sharding.Sharding):
-        # isinstance check FIRST: Sharding subclasses may be callable.
-        return jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(leaf, sharding), host
-        )
-    if callable(sharding):
+    _wait_pending(path)
+    try:
+        is_manifest = _is_manifest_file(path)
+    except FileNotFoundError:
+        raise
+    if not is_manifest:
+        host = restore(path, template)
+        rule = _resolve_sharding(sharding, template)
         return jax.tree_util.tree_map_with_path(
-            lambda p, leaf: jax.device_put(leaf, sharding(p, leaf)), host
+            lambda p, leaf: jax.device_put(leaf, rule(p, leaf)), host
         )
-    return jax.tree_util.tree_map(jax.device_put, host, sharding)
+
+    try:
+        manifest = _read_manifest(path)
+    except Exception as e:
+        sidecar = quarantine(path)
+        log.warning("checkpoint %s unreadable (%r); quarantined to %s",
+                    path, e, sidecar)
+        raise CheckpointCorruptError(path, sidecar, repr(e)) from e
+
+    rule = _resolve_sharding(sharding, template)
+    leaves = manifest["leaves"]
+    reader = _ShardReader(path)
+    try:
+
+        def place(tree_path, tleaf):
+            key = _path_str(tree_path)
+            if key not in leaves:
+                raise KeyError(
+                    f"checkpoint at {path!r} missing array for tree path "
+                    f"{key!r}"
+                )
+            entry = leaves[key]
+            want_shape = tuple(getattr(tleaf, "shape", entry["shape"]))
+            if tuple(entry["shape"]) != want_shape:
+                raise ValueError(
+                    f"shape mismatch at {key!r}: saved "
+                    f"{tuple(entry['shape'])} vs template {want_shape}"
+                )
+            dtype = getattr(tleaf, "dtype", np.dtype(entry["stored_dtype"]))
+            sds = jax.ShapeDtypeStruct(want_shape, dtype)
+            return _place_leaf(entry, reader, rule(tree_path, sds), dtype)
+
+        return jax.tree_util.tree_map_with_path(place, template)
+    except (CheckpointCorruptError, KeyError, ValueError):
+        raise
+    except Exception as e:
+        # A manifest that parsed but whose shard set is missing/torn on
+        # read: quarantine so recovery falls back, same as load_arrays.
+        sidecar = quarantine(path)
+        log.warning("checkpoint %s shard set unreadable (%r); quarantined "
+                    "to %s", path, e, sidecar)
+        raise CheckpointCorruptError(path, sidecar, repr(e)) from e
+    finally:
+        reader.close()
 
 
 def exists(path: str) -> bool:
@@ -371,3 +807,99 @@ def exists(path: str) -> bool:
     collective (which would deadlock for host-local tasks)."""
     _wait_pending(path)
     return os.path.exists(path)
+
+
+def delete(path: str) -> None:
+    """Remove a checkpoint: the manifest (or legacy single file) plus every
+    generation's shard files. Quarantine sidecars are kept (they are
+    evidence, not state). Missing paths are fine; joins any in-flight
+    async write first so a just-scheduled save doesn't resurrect files."""
+    try:
+        _wait_pending(path)
+    except RuntimeError:
+        pass  # a failed write is moot — we are deleting the target
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    for f in glob.glob(glob.escape(path) + ".g*.npz"):
+        if _SHARD_RE.search(f):
+            try:
+                os.unlink(f)
+            except OSError:
+                log.warning("could not remove checkpoint shard %s", f)
+
+
+# ------------------------------------------------------------------ CLI views
+def summarize(path: str) -> Dict[str, Any]:
+    """One checkpoint's manifest summary for ``python -m saturn_tpu.analysis
+    ckpt``: format, shard/leaf counts, on-disk bytes, pspec fingerprint and
+    verification verdict. Never raises — unreadable checkpoints report
+    ``ok: False``."""
+    out: Dict[str, Any] = {"path": path, "ok": False, "format": None,
+                           "leaves": 0, "shards": 0, "shard_files": 0,
+                           "bytes": 0, "pspec_fingerprint": None,
+                           "generation": None}
+    try:
+        out["bytes"] = os.path.getsize(path)
+        if _is_manifest_file(path):
+            out["format"] = "sharded-manifest"
+            m = _read_manifest(path)
+            out["generation"] = m.get("generation")
+            out["pspec_fingerprint"] = m.get("pspec_fingerprint")
+            out["leaves"] = len(m["leaves"])
+            d = os.path.dirname(os.path.abspath(path))
+            files = set()
+            for entry in m["leaves"].values():
+                out["shards"] += len(entry["shards"])
+                files.update(sh["file"] for sh in entry["shards"])
+            out["shard_files"] = len(files)
+            for fname in files:
+                fpath = os.path.join(d, fname)
+                if os.path.exists(fpath):
+                    out["bytes"] += os.path.getsize(fpath)
+        else:
+            out["format"] = "legacy-npz"
+            with np.load(path) as data:
+                out["leaves"] = len(data.files)
+                out["shards"] = len(data.files)
+            out["shard_files"] = 1
+        out["ok"] = verify(path)
+    except Exception as e:
+        out["error"] = repr(e)
+    return out
+
+
+def summarize_dir(directory: str) -> Dict[str, Any]:
+    """Directory-level checkpoint inventory: every checkpoint (manifest or
+    legacy), corrupt sidecars, and orphan shard files no manifest owns."""
+    directory = os.path.abspath(directory)
+    checkpoints, sidecars, shard_files = [], [], set()
+    referenced = set()
+    for name in sorted(os.listdir(directory)):
+        full = os.path.join(directory, name)
+        if not os.path.isfile(full):
+            continue
+        if ".corrupt" in name:
+            sidecars.append(name)
+            continue
+        if _SHARD_RE.search(name):
+            shard_files.add(name)
+            continue
+        if name.endswith(".npz"):
+            summ = summarize(full)
+            checkpoints.append(summ)
+            if summ.get("format") == "sharded-manifest" and summ.get("ok"):
+                try:
+                    m = _read_manifest(full)
+                    for entry in m["leaves"].values():
+                        referenced.update(sh["file"] for sh in entry["shards"])
+                except Exception:
+                    pass
+    return {
+        "dir": directory,
+        "checkpoints": checkpoints,
+        "corrupt_sidecars": sidecars,
+        "orphan_shards": sorted(shard_files - referenced),
+        "total_bytes": sum(c.get("bytes", 0) for c in checkpoints),
+    }

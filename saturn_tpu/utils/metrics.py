@@ -126,6 +126,14 @@ def configure(path: Optional[str]) -> None:
         _WRITER = MetricsWriter(path) if path else None
 
 
+def enabled() -> bool:
+    """True when a metrics sink is configured — lets emitters skip *computing*
+    expensive event fields (e.g. the task_interval MFU numerator's one-time
+    shardflow trace) when every event would be dropped anyway."""
+    # sanctioned-unlocked: single-reference read of a lock-managed global
+    return _WRITER is not None
+
+
 def event(kind: str, **fields) -> None:
     """Emit an event if metrics are configured; no-op otherwise."""
     # Invariant: _WRITER swaps are atomic (one assignment under _CONF_LOCK)

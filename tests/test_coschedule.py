@@ -774,7 +774,7 @@ class TestTrajectoryEquivalence:
         )
         engine.execute([solo], {"co-eq": 6}, 100.0, plan_solo, real_topo)
         ckpt.flush()
-        ref = dict(np.load(solo.ckpt_path))
+        ref = ckpt.load_arrays(solo.ckpt_path)
 
         pair_a = _with_strategy(
             _real_task(tmp_path, "pair-a", "co-eq"), DataParallel()
@@ -797,14 +797,14 @@ class TestTrajectoryEquivalence:
         )
         assert not errors
         ckpt.flush()
-        got = dict(np.load(pair_a.ckpt_path))
+        got = ckpt.load_arrays(pair_a.ckpt_path)
 
         assert int(ref["step"]) == int(got["step"]) == 6
         assert set(ref) == set(got)
         for key in ref:
             np.testing.assert_array_equal(ref[key], got[key], err_msg=key)
         # the neighbor also completed its own 6 steps
-        mate = dict(np.load(pair_b.ckpt_path))
+        mate = ckpt.load_arrays(pair_b.ckpt_path)
         assert int(mate["step"]) == 6
 
 

@@ -216,7 +216,8 @@ _ORCH_WORKER = textwrap.dedent("""
     assert sorted(res["completed"]) == ["mh-cross", "mh-local"], res
     assert not res["failed"], res
     for t in tasks:
-        ck = np.load(t.ckpt_path)
+        from saturn_tpu.utils import checkpoint as _ck
+        ck = _ck.load_arrays(t.ckpt_path)
         assert int(ck["step"]) == 2, (t.name, int(ck["step"]))
     print(f"ORCH_OK {pid}")
 """)

@@ -16,6 +16,7 @@ from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.data.lm_dataset import make_lm_dataset
 from saturn_tpu.models.gpt2 import build_gpt2
 from saturn_tpu.models.loss import pretraining_loss
+from saturn_tpu.utils import checkpoint as ckpt_mod
 
 
 def make_task(tmp_path, name, lr, batch_count=8):
@@ -54,7 +55,7 @@ def test_search_then_orchestrate(tmp_path, devices8):
     for t in tasks:
         assert t.total_batches == 0
         assert t.has_ckpt()
-        state = np.load(t.ckpt_path)
+        state = ckpt_mod.load_arrays(t.ckpt_path)
         assert state["step"] == 8  # all batches ran exactly once
 
 

@@ -37,12 +37,12 @@ class TestDataParallel:
     def test_resume_advances_step(self, tiny_task, devices8):
         tech = DataParallel()
         run_search_and_execute(tech, tiny_task, devices8[:2], n_batches=2)
-        state1 = np.load(tiny_task.ckpt_path)
+        state1 = ckpt.load_arrays(tiny_task.ckpt_path)
         assert state1["step"] == 2
         # resume on a DIFFERENT submesh size — reshard from checkpoint
         tech.execute(tiny_task, devices8[:4], tid=0, override_batch_count=3)
         ckpt.flush()  # execute()'s disk write is async
-        state2 = np.load(tiny_task.ckpt_path)
+        state2 = ckpt.load_arrays(tiny_task.ckpt_path)
         assert state2["step"] == 5
 
     def test_params_replicated(self, tiny_task, devices8):
@@ -103,7 +103,7 @@ class TestFSDP:
         tiny_task.select_strategy(2)
         dp.execute(tiny_task, devices8[:2], tid=0, override_batch_count=2)
         ckpt.flush()  # execute()'s disk write is async
-        state = np.load(tiny_task.ckpt_path)
+        state = ckpt.load_arrays(tiny_task.ckpt_path)
         assert state["step"] == 4
 
 
@@ -206,7 +206,7 @@ class TestHostOffload:
         tiny_task.select_strategy(2)
         dp.execute(tiny_task, devices8[:2], tid=0, override_batch_count=2)
         ckpt.flush()  # execute()'s disk write is async
-        state = np.load(tiny_task.ckpt_path)
+        state = ckpt.load_arrays(tiny_task.ckpt_path)
         assert state["step"] == 4
 
 

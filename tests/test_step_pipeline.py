@@ -150,7 +150,7 @@ def _run_interval(task, tech, devices, n, window_size):
     tech.execute(task, devices, 0, override_batch_count=n,
                  window_size=window_size)
     ckpt.flush()
-    return dict(np.load(task.ckpt_path))
+    return ckpt.load_arrays(task.ckpt_path)
 
 
 class TestFusedEquivalence:
@@ -308,7 +308,7 @@ def test_orchestrate_equivalent_across_window_caps(tmp_path, devices8,
         for t in tasks:
             assert t.total_batches == 0
             assert t.has_ckpt()
-        finals[cap] = {t.name: dict(np.load(t.ckpt_path)) for t in tasks}
+        finals[cap] = {t.name: ckpt.load_arrays(t.ckpt_path) for t in tasks}
 
     for name in finals["1"]:
         a, b = finals["1"][name], finals["4"][name]

@@ -84,6 +84,48 @@ def _swallow_findings(roots=SWALLOW_ROOTS) -> list:
     return findings
 
 
+#: Calls that would reintroduce a full-tree gather/materialization funnel
+#: into the sharded checkpoint writer. Round 19 removed the last sanctioned
+#: ones; any new use in utils/checkpoint.py is a format regression.
+_CKPT_FORBIDDEN_CALLS = frozenset({"process_allgather", "device_get"})
+
+
+def _ckpt_format_findings(
+    path: str = "saturn_tpu/utils/checkpoint.py",
+) -> list:
+    """The checkpoint-format gate: the sharded writer must stay zero-gather.
+    Flags any call to ``process_allgather`` or ``jax.device_get`` of a whole
+    tree/leaf inside ``utils/checkpoint.py`` — per-shard ``shard.data``
+    copies are the only sanctioned device→host traffic there."""
+    findings = []
+    full = os.path.join(REPO, path)
+    with open(full) as f:
+        tree = ast.parse(f.read(), filename=full)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name not in _CKPT_FORBIDDEN_CALLS:
+            continue
+        if name == "device_get":
+            # the per-shard copy (device_get of shard.data) is the sharded
+            # format's one legitimate transfer; a device_get of anything
+            # else in this file is a full-leaf materialization
+            arg = node.args[0] if node.args else None
+            if (isinstance(arg, ast.Attribute) and arg.attr == "data"):
+                continue
+        findings.append({
+            "path": path,
+            "line": node.lineno,
+            "message": f"{name}() in the checkpoint writer reintroduces a "
+                       "full-tree gather funnel — the sharded manifest "
+                       "format writes per-shard local copies only",
+        })
+    return findings
+
+
 def _have(tool: str) -> bool:
     return importlib.util.find_spec(tool) is not None
 
@@ -144,6 +186,12 @@ def main() -> int:
     swallows = _swallow_findings()
     results["swallowed-exceptions"] = "ok" if not swallows else swallows
     failed |= bool(swallows)
+
+    # checkpoint-format: the sharded writer must never regress to a gather
+    # funnel (process_allgather / full-leaf device_get in checkpoint.py).
+    ckpt_regressions = _ckpt_format_findings()
+    results["ckpt-format"] = "ok" if not ckpt_regressions else ckpt_regressions
+    failed |= bool(ckpt_regressions)
 
     # saturn-tsan: the concurrency pass over the thread-bearing packages.
     # Gates on unsanctioned SAT-C findings (errors); sanctioned cases are
