@@ -209,6 +209,70 @@ def validate_sweep_prune_row(row) -> list:
     return problems
 
 
+#: Required key -> type for the ``benchmarks/pipeline_schedule.py`` row.
+#: Same contract as the other ROW_REQUIRED tables: the bench self-validates
+#: before printing, and recorded rows can be re-checked without re-running.
+PIPELINE_ROW_REQUIRED = {
+    "metric": str,
+    "stages": int,
+    "microbatches": int,
+    "devices": int,
+    "gpipe_ms": float,                 # AD-GPipe steady-state step time
+    "f1b_ms": float,                   # staged 1F1B steady-state step time
+    "speedup_1f1b_vs_gpipe": float,    # acceptance bar: >= 1.0 at M = S
+    "bubble_gpipe": float,             # analytic (S-1)/(M+S-1)
+    "bubble_1f1b": float,              # analytic (S-1)/(M+2(S-1))
+    "status": str,
+}
+
+
+def validate_pipeline_row(row) -> list:
+    """Schema-check one pipeline-schedule row; returns human-readable
+    problems (empty list = valid)."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in PIPELINE_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "pipeline_schedule":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'pipeline_schedule'"
+        )
+    s = row.get("stages")
+    if isinstance(s, int) and not isinstance(s, bool) and s < 2:
+        problems.append("stages < 2 (no pipeline to schedule)")
+    sp = row.get("speedup_1f1b_vs_gpipe")
+    if isinstance(sp, (int, float)) and not isinstance(sp, bool) and sp < 1.0:
+        problems.append(
+            f"speedup_1f1b_vs_gpipe {sp} < 1.0 (1F1B must beat GPipe "
+            "steady-state at M = S)"
+        )
+    bg, bf = row.get("bubble_gpipe"), row.get("bubble_1f1b")
+    for key, b in (("bubble_gpipe", bg), ("bubble_1f1b", bf)):
+        if (isinstance(b, (int, float)) and not isinstance(b, bool)
+                and not 0.0 <= b < 1.0):
+            problems.append(f"{key} {b} outside [0, 1)")
+    if (isinstance(bg, (int, float)) and isinstance(bf, (int, float))
+            and not isinstance(bg, bool) and not isinstance(bf, bool)
+            and bf >= bg):
+        problems.append(
+            f"bubble_1f1b {bf} >= bubble_gpipe {bg} (1F1B's warmup-"
+            "cooldown bubble must be the smaller one)"
+        )
+    return problems
+
+
 #: Required key -> type for one ``benchmarks/chaos_campaign.py`` output row.
 #: The campaign bench self-validates against this before printing, and CI
 #: can re-check recorded rows — a schema drift (renamed key, stringified

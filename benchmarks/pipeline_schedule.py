@@ -1,0 +1,125 @@
+"""GPipe vs 1F1B steady-state step time at the same stage cuts (round 20).
+
+The schedule swap's whole pitch: same spans, same microbatches, bit-identical
+summed gradients (pinned in ``tests/test_pipeline.py``) — but the backward
+launches ``2(S-1)`` ticks behind its forward instead of after the full
+forward flush, so the warmup-cooldown bubble shrinks from ``(S-1)/(M+S-1)``
+to ``(S-1)/(M+2(S-1))`` and the activation stash from ``M`` microbatches to
+``min(M, 2S-1)``. This bench times both schedules through the same executor
+at ``M = S`` (the acceptance point: the smallest microbatch count where
+GPipe's AD program still runs) and emits one self-validated row.
+
+Run: ``python benchmarks/pipeline_schedule.py [--preset test-tiny] [--json]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main():
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="test-tiny")
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--stages", type=int, default=4)
+    ap.add_argument("--microbatches", type=int, default=0,
+                    help="0 = stages (the M = S acceptance point)")
+    ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    ap.add_argument("--json", action="store_true",
+                    help="print the row as one JSON line")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from saturn_tpu import HParams, Task
+    from saturn_tpu.data.lm_dataset import make_lm_dataset
+    from saturn_tpu.models.gpt2 import build_gpt2
+    from saturn_tpu.models.loss import pretraining_loss
+    from saturn_tpu.ops.pipeline import schedule_bubble_fraction
+    from saturn_tpu.parallel.pp import Pipeline
+    from saturn_tpu.utils.timing import time_train_step
+
+    devices = jax.devices()
+    n = 1 << (len(devices).bit_length() - 1)
+    devices = devices[:n]
+    s = min(args.stages, n, args.layers)
+    while n % s != 0:
+        s -= 1
+    m = args.microbatches or s
+    print(f"backend={devices[0].platform} devices={n} preset={args.preset} "
+          f"seq={args.seq} batch={args.batch} stages={s} microbatches={m}")
+
+    task = Task(
+        get_model=lambda **kw: build_gpt2(
+            args.preset, seq_len=args.seq, n_layers=args.layers, **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=args.seq, batch_size=args.batch,
+            vocab_size=256 if args.preset == "test-tiny" else 50304,
+            n_tokens=args.seq * args.batch * 4,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=1e-3, batch_count=4),
+        save_dir="/tmp/pp_schedule_bench_ckpts",
+    )
+
+    pp = Pipeline()
+    times = {}
+    for schedule in ("gpipe", "1f1b"):
+        cfg = {"stages": s, "microbatches": m, "schedule": schedule,
+               "remat": False}
+        bundle = pp.build(task, devices, cfg)
+        state = bundle.init()
+        batch = jax.device_put(
+            task.get_dataset().batch(0), bundle.batch_sharding)
+        dt = time_train_step(bundle.compiled, state, batch,
+                             n_timed=5, n_warmup=2)
+        times[schedule] = dt
+        tput = args.batch * args.seq / dt
+        print(f"{schedule:6s} {dt*1e3:9.1f} ms/step  {tput:10.0f} tok/s  "
+              f"bubble={schedule_bubble_fraction(schedule, s, m):.3f}")
+
+    row = {
+        "metric": "pipeline_schedule",
+        "stages": s,
+        "microbatches": m,
+        "devices": n,
+        "gpipe_ms": round(times["gpipe"] * 1e3, 3),
+        "f1b_ms": round(times["1f1b"] * 1e3, 3),
+        "speedup_1f1b_vs_gpipe": round(times["gpipe"] / times["1f1b"], 4),
+        "bubble_gpipe": schedule_bubble_fraction("gpipe", s, m),
+        "bubble_1f1b": schedule_bubble_fraction("1f1b", s, m),
+        "status": "ok",
+    }
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_guard_pp", os.path.join(os.path.dirname(__file__),
+                                       "bench_guard.py"))
+    guard = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(guard)
+    problems = guard.validate_pipeline_row(row)
+    if problems:
+        row["status"] = "invalid"
+        for p in problems:
+            print(f"ROW INVALID: {p}")
+    if args.json:
+        print(json.dumps(row, sort_keys=True))
+    else:
+        print(row)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,7 +28,7 @@ SAT-P020   error     assignment block exceeds the topology's buddy capacity
 SAT-P021   error     assignment apportionment differs from its block size
 SAT-P022   error     task has no feasible strategy at the assigned apportionment
 SAT-P023   warning   co-schedule group members do not share one device block
-SAT-P024   warning   co-scheduled task has no measured host fraction (> 0)
+SAT-P024   warning   co-scheduled task has no host fraction or schedule bubble (> 0)
 SAT-P030   error     negative start time or negative runtime
 SAT-P031   error     task starts before a task it depends on
 SAT-P032   warning   recorded makespan is below the last assignment's end time

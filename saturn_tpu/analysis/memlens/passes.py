@@ -242,6 +242,32 @@ def audit_point(
 _PRED_CACHE: Dict[Any, Optional[MemoryProfile]] = {}
 
 
+def pipeline_stash_bytes(
+    schedule: str, n_stages: int, n_microbatches: int,
+    stage_input_bytes: int,
+) -> int:
+    """Analytic activation-stash residency of the staged pipeline programs
+    (``ops/pipeline.staged_pipeline_loss_and_grads``).
+
+    The schedule's scan carries a depth-``D`` ring of stage-INPUT
+    microbatch activations, ``D = min(M, C+1)`` with ``C`` the backward
+    launch offset: ``2(S-1)`` for 1F1B — so ``D <= 2S-1``, BOUNDED in the
+    microbatch count — and ``M + 2(S-1)`` for the GPipe ordering, where
+    every in-flight microbatch stays resident (``D = M``). Backward
+    recomputes the stage forward from the stashed input (torchgpipe-style
+    checkpointing), so this ring is the dominant schedule-dependent
+    liveness term; the generic scan-carry rule in
+    :mod:`~saturn_tpu.analysis.memlens.liveness` must reproduce it, and
+    the SAT-M regression test (``tests/test_memlens.py``) holds the two
+    to each other — a liveness change that stops seeing the stash, or a
+    schedule change that silently grows it, breaks the band.
+    """
+    from saturn_tpu.ops.pipeline import stash_depth
+
+    depth = stash_depth(int(n_stages), int(n_microbatches), str(schedule))
+    return int(depth) * int(stage_input_bytes)
+
+
 def predict_profile(
     tech: Any, task: Any, devices: Sequence[Any],
     config: Optional[Dict[str, Any]] = None, window: int = 1,

@@ -413,12 +413,16 @@ def _feasibility_diagnostics(plan: Any, topology: Any,
                 continue
             strat = getattr(t, "strategies", {}).get(a.apportionment)
             hf = getattr(strat, "host_fraction", 0.0) if strat else 0.0
-            if not hf or hf <= 0.0:
+            # A pipeline job with a measured-zero host fraction can still be
+            # a legitimate co-schedule member: its analytic schedule bubble
+            # (GPipe/1F1B warmup-cooldown) is the gap the partner fills.
+            bubble = getattr(strat, "bubble_fraction", 0.0) if strat else 0.0
+            if (not hf or hf <= 0.0) and (not bubble or bubble <= 0.0):
                 out.append(make(
                     "SAT-P024", "warning",
                     f"co-scheduled task {m!r} has no measured host fraction "
-                    "at its apportionment — the co-location term had no "
-                    "bubble to fill",
+                    "or schedule bubble at its apportionment — the "
+                    "co-location term had no idle window to fill",
                     counterexample={"task": m, "group": gi,
                                     "apportionment": a.apportionment},
                     category="feasibility",

@@ -165,6 +165,17 @@ def synthesize_strategies(
                 t = estimate_step_seconds(ledger, g, crossing=cross)
                 if t < best_t:
                     best_t = t
+                    # Analytic schedule bubble (pipeline GPipe/1F1B
+                    # warmup-cooldown): like the runtime prior itself it
+                    # needs no trial, so cold-started strategies price
+                    # co-location the same way measured ones do.
+                    bubble = 0.0
+                    bf = getattr(tech, "config_bubble_fraction", None)
+                    if callable(bf):
+                        try:
+                            bubble = min(max(float(bf(config)), 0.0), 1.0)
+                        except Exception:
+                            bubble = 0.0
                     best = Strategy(
                         executor=tech,
                         apportionment=g,
@@ -175,6 +186,7 @@ def synthesize_strategies(
                         cache_key=pcache.fingerprint(
                             task_sig, name, g, topo_sig
                         ),
+                        bubble_fraction=bubble,
                     )
         if best is not None:
             best._static_prior_estimate = best_t

@@ -79,6 +79,14 @@ class Strategy:
     # what pre-existing cache entries report) predicts no overlap win, so a
     # strategy without a measurement is never co-scheduled.
     host_fraction: float = field(default=0.0)
+    # Analytic schedule-bubble fraction of a steady-state step, in [0, 1):
+    # device-idle time (pipeline warmup/cooldown) a co-scheduled partner's
+    # device windows could fill. Recomputed from ``params`` by every install
+    # path (``BaseTechnique.config_bubble_fraction``) rather than measured —
+    # GPipe pays (S-1)/(M+S-1), 1F1B only (S-1)/(M+2(S-1)), and the solver's
+    # co-location term adds it to ``host_fraction`` so a 1F1B job is priced
+    # as the worse gap-filler partner it is.
+    bubble_fraction: float = field(default=0.0)
 
     def __post_init__(self) -> None:
         if self.apportionment < 1:

@@ -320,6 +320,20 @@ class SPMDTechnique(BaseTechnique):
         with self._reports_lock:
             return self._host_fracs.pop((task_name, size), None)
 
+    def config_bubble_fraction(self, config: Dict[str, Any]) -> float:
+        """Analytic DEVICE-idle fraction of a steady-state step under
+        ``config`` — schedule bubbles (pipeline warmup/cooldown) a
+        co-scheduled partner's device windows could fill, in [0, 1).
+
+        Unlike ``host_fraction`` this is derived from the config, not
+        measured: the bubble is a property of the schedule shape (stage and
+        microbatch counts), so every install path — trial, cache hit,
+        interpolated fill, elastic re-synthesis — recomputes it exactly.
+        Dense sharding techniques have no schedule bubble; the pipeline
+        executor overrides this with the GPipe/1F1B bubble formulas.
+        """
+        return 0.0
+
     def release_task(self, task_name: str) -> None:
         """Drop every cached compiled program for ``task_name`` — called when
         the task completes or is evicted, so finished sweeps don't pin

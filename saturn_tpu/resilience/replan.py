@@ -178,6 +178,17 @@ class ElasticReplanner:
         all_static = all(
             getattr(feas[g], "static_prior", False) for g, _ in pts
         )
+        # The schedule bubble is analytic in the anchor's params (stage and
+        # microbatch counts survive the re-synthesis unchanged), so the
+        # synthesized strategy keeps pricing co-location correctly — an
+        # Amdahl fit can estimate the runtime but not the schedule shape.
+        bubble = 0.0
+        bf = getattr(anchor.executor, "config_bubble_fraction", None)
+        if callable(bf) and anchor.params:
+            try:
+                bubble = min(max(float(bf(anchor.params)), 0.0), 1.0)
+            except Exception:
+                bubble = 0.0
         added: List[int] = []
         g = capacity
         while g >= 1:
@@ -191,6 +202,7 @@ class ElasticReplanner:
                     per_batch_time=pbt,
                     interpolated=True,
                     static_prior=all_static,
+                    bubble_fraction=bubble,
                 )
                 added.append(g)
                 break  # one synthesized size (the largest fitting) is enough

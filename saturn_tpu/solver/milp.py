@@ -293,6 +293,23 @@ def _host_fraction_of(task, size: int) -> float:
     return min(max(hf, 0.0), 1.0)
 
 
+def _fillable_fraction_of(task, size: int) -> float:
+    """Fraction of a steady-state batch during which the job's DEVICES are
+    idle and a co-scheduled partner could run: measured host-side staging
+    (``host_fraction``) plus the analytic schedule bubble
+    (``bubble_fraction`` — pipeline warmup/cooldown ticks). Clamped to
+    [0, 1]. A GPipe job donates its (S-1)/(M+S-1) bubble to a partner; the
+    same job under 1F1B donates only (S-1)/(M+2(S-1)), so switching
+    schedules shrinks the predicted interleave win — exactly the trade the
+    co-location term must see."""
+    strat = getattr(task, "strategies", {}).get(size)
+    if strat is None:
+        return 0.0
+    hf = float(getattr(strat, "host_fraction", 0.0) or 0.0)
+    bubble = float(getattr(strat, "bubble_fraction", 0.0) or 0.0)
+    return min(max(hf, 0.0) + max(bubble, 0.0), 1.0)
+
+
 def coschedule_candidates(
     task_list: List,
     choices: Dict[str, List[Tuple[int, "Block", float]]],
@@ -302,14 +319,19 @@ def coschedule_candidates(
 
     For each pair and each (size, block) option BOTH tasks could take, the
     interleaved pair occupies the block for
-    ``comb = max(rt1, rt2, dev1 + dev2)`` where ``dev = (1 - host_fraction)
-    * rt`` — device phases serialize on the shared block, host phases hide
-    under the partner's device windows. The pair is a candidate only when
-    the best common option predicts ``(rt1 + rt2) / comb >= min_gain``: two
-    compute-bound jobs give ``comb = rt1 + rt2`` (gain 1.0x) and never
-    qualify, which is exactly the "choose co-location only when the host
-    fraction predicts a win" contract. Returns ``(n1, n2, [(i1, i2, comb),
-    ...])`` with option indices into each task's choice list.
+    ``comb = max(rt1, rt2, dev1 + dev2)`` where ``dev = (1 - fillable) *
+    rt`` and ``fillable = host_fraction + bubble_fraction`` — device phases
+    serialize on the shared block; host staging AND schedule bubbles
+    (pipeline warmup/cooldown) hide under the partner's device windows. The
+    pair is a candidate only when the best common option predicts
+    ``(rt1 + rt2) / comb >= min_gain``: two compute-bound bubble-free jobs
+    give ``comb = rt1 + rt2`` (gain 1.0x) and never qualify, which is
+    exactly the "choose co-location only when the profile predicts a win"
+    contract — and a job whose solver-picked schedule is 1F1B offers a
+    smaller bubble than the same job under GPipe, so pairs that only
+    cleared ``min_gain`` on the fatter GPipe bubble drop out. Returns
+    ``(n1, n2, [(i1, i2, comb), ...])`` with option indices into each
+    task's choice list.
     """
     by_name = {t.name: t for t in task_list}
     names = [t.name for t in task_list]
@@ -327,9 +349,9 @@ def coschedule_candidates(
                 if hit is None:
                     continue
                 i2, rt2 = hit
-                hf1 = _host_fraction_of(by_name[n1], s)
-                hf2 = _host_fraction_of(by_name[n2], s)
-                comb = max(rt1, rt2, (1.0 - hf1) * rt1 + (1.0 - hf2) * rt2)
+                f1 = _fillable_fraction_of(by_name[n1], s)
+                f2 = _fillable_fraction_of(by_name[n2], s)
+                comb = max(rt1, rt2, (1.0 - f1) * rt1 + (1.0 - f2) * rt2)
                 common.append((i1, i2, comb))
                 if comb > 1e-9:
                     best_gain = max(best_gain, (rt1 + rt2) / comb)

@@ -234,6 +234,14 @@ def _search_inner(
         lib.register_default_library()
     classes = lib.retrieve(technique_names)
     techniques = [(cls.name if hasattr(cls, "name") else cls.__name__, cls()) for cls in classes]
+    for _, tech in techniques:
+        # Candidate grids may depend on the pool shape — e.g. the pipeline
+        # executor only proposes cross-slice ``stage_major`` layouts when
+        # the sweep's blocks can actually outgrow a slice.
+        try:
+            tech.topology = topo
+        except Exception:
+            pass  # plugin with __slots__/frozen surface: grid stays topology-blind
 
     update_lock = threading.Lock()
 
@@ -279,8 +287,18 @@ def _search_inner(
 
         ``host_fraction`` feeds the solver's co-location term; interpolated
         entries pass the 0.0 default on purpose — a co-schedule decision
-        needs a measured staging/compute split, not a fitted guess."""
+        needs a measured staging/compute split, not a fitted guess. The
+        schedule-bubble fraction, by contrast, is analytic in the config
+        (``config_bubble_fraction``), so every path — trial, cache hit,
+        interpolated fill — recomputes it here identically."""
         total = per_batch * lane.task.total_batches  # reference ``:26``
+        bubble = 0.0
+        bf = getattr(lane.tech, "config_bubble_fraction", None)
+        if callable(bf) and params:
+            try:
+                bubble = min(max(float(bf(params)), 0.0), 1.0)
+            except Exception:
+                bubble = 0.0
         with update_lock:
             cur = lane.task.strategies.get(g)
             if cur is None or not cur.feasible or total < cur.runtime:
@@ -293,6 +311,7 @@ def _search_inner(
                     interpolated=(source == "interpolated"),
                     cache_key=lane.keys.get(g),
                     host_fraction=float(host_fraction or 0.0),
+                    bubble_fraction=bubble,
                 )
 
     def note_memory_floor(lane: _Lane, g: int) -> None:

@@ -170,6 +170,25 @@ def dispatch_signature() -> str:
         return "per-step"
 
 
+def schedule_signature() -> str:
+    """Version of the pipeline schedule set — part of every fingerprint.
+
+    The pipeline executor's candidate grid carries the schedule kind
+    (GPipe vs 1F1B) in each config, and the trial runner times both; a
+    profile recorded before a schedule existed (or after one's program
+    changed) describes a grid the sweep no longer runs, so stale entries
+    must MISS rather than warm-start the solver with configs execution
+    would route differently. Imported lazily like ``dispatch_signature``:
+    utils must not import ops at module level.
+    """
+    try:
+        from saturn_tpu.ops.pipeline import schedule_signature as _ss
+
+        return _ss()
+    except Exception:
+        return "gpipe-only"
+
+
 def fingerprint(
     task_sig: str, technique: str, size: int, topo_sig: str,
     dispatch: Optional[str] = None,
@@ -206,6 +225,10 @@ def fingerprint(
             "topology": topo_sig,
             "jax": jax_version,
             "dispatch": dispatch_signature() if dispatch is None else dispatch,
+            # Pipeline schedule-set version: a GPipe-only profile recorded
+            # before 1F1B landed must miss — its cached params lack the
+            # schedule key and its timing raced a narrower grid.
+            "schedules": schedule_signature(),
         },
         sort_keys=True,
     )

@@ -194,3 +194,55 @@ class TestBenchGuard:
         )
         assert guard.main() == 0
         assert json.loads(capsys.readouterr().out)["status"] == "skipped"
+
+
+class TestPipelineScheduleRow:
+    """Round 20: the pipeline-schedule bench row contract."""
+
+    GOOD = {
+        "metric": "pipeline_schedule",
+        "stages": 4,
+        "microbatches": 4,
+        "devices": 8,
+        "gpipe_ms": 158.1,
+        "f1b_ms": 75.4,
+        "speedup_1f1b_vs_gpipe": 2.0981,
+        "bubble_gpipe": 3 / 7,
+        "bubble_1f1b": 3 / 10,
+        "status": "ok",
+    }
+
+    @pytest.fixture()
+    def guard(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench_guard_pp_row",
+            os.path.join(REPO, "benchmarks", "bench_guard.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_good_row_passes(self, guard):
+        assert guard.validate_pipeline_row(dict(self.GOOD)) == []
+
+    def test_missing_key_and_non_dict(self, guard):
+        row = dict(self.GOOD)
+        del row["f1b_ms"]
+        assert any("f1b_ms" in p for p in guard.validate_pipeline_row(row))
+        assert guard.validate_pipeline_row([1]) != []
+
+    def test_bool_in_count_field_flagged(self, guard):
+        row = dict(self.GOOD, stages=True)
+        assert any("is bool" in p for p in guard.validate_pipeline_row(row))
+
+    def test_speedup_below_one_fails_the_bar(self, guard):
+        row = dict(self.GOOD, speedup_1f1b_vs_gpipe=0.97)
+        assert any("beat GPipe" in p for p in
+                   guard.validate_pipeline_row(row))
+
+    def test_bubble_ordering_enforced(self, guard):
+        row = dict(self.GOOD, bubble_1f1b=0.5)  # >= bubble_gpipe 0.4286
+        assert any("smaller one" in p for p in
+                   guard.validate_pipeline_row(row))
+        row = dict(self.GOOD, bubble_gpipe=1.4)
+        assert any("outside" in p for p in guard.validate_pipeline_row(row))

@@ -180,6 +180,51 @@ class TestCoscheduleCandidates:
         b = FakeTask("b", 10, [4], tech, hf=0.0)
         assert coschedule_candidates([a, b], self._choices(), 2.0) == []
 
+    def test_bubble_fraction_qualifies_pair(self):
+        """Round 20: a schedule bubble is a device-idle window exactly like a
+        host stall — a GPipe-shaped task with zero host fraction still
+        qualifies for co-location on its bubble alone."""
+        tech = RecordingTech()
+        a = FakeTask("a", 10, [4], tech, hf=0.0)
+        b = FakeTask("b", 10, [4], tech, hf=0.0)
+        a.strategies[4].bubble_fraction = 0.8  # deep-pipeline GPipe bubble
+        cands = coschedule_candidates([a, b], self._choices(), 1.15)
+        assert len(cands) == 1
+        # comb = max(10, 8, 0.2*10 + 8) = 10 -> same win as hf=0.8
+        assert cands[0][2][0][2] == pytest.approx(10.0)
+
+    def test_smaller_1f1b_bubble_shrinks_the_gain(self):
+        """1F1B's smaller bubble is priced honestly: less idle to fill means
+        less co-location gain than the same pair under GPipe's bubble."""
+        from saturn_tpu.ops.pipeline import schedule_bubble_fraction
+
+        tech = RecordingTech()
+        gp = schedule_bubble_fraction("gpipe", 4, 4)   # 3/7
+        f1 = schedule_bubble_fraction("1f1b", 4, 4)    # 3/10
+
+        def comb_for(bubble):
+            a = FakeTask("a", 10, [4], tech, hf=0.0)
+            b = FakeTask("b", 10, [4], tech, hf=0.0)
+            a.strategies[4].bubble_fraction = bubble
+            b.strategies[4].bubble_fraction = bubble
+            cands = coschedule_candidates([a, b], self._choices(), 1.0001)
+            assert cands, f"bubble {bubble} should still qualify"
+            return cands[0][2][0][2]
+
+        assert comb_for(f1) > comb_for(gp)  # less fillable idle -> worse comb
+
+    def test_bubble_and_host_fraction_compose(self):
+        """The fillable window is host + bubble (clamped): together they can
+        absorb a partner neither could alone."""
+        tech = RecordingTech()
+        a = FakeTask("a", 10, [4], tech, hf=0.5)
+        b = FakeTask("b", 10, [4], tech, hf=0.0)
+        a.strategies[4].bubble_fraction = 0.5
+        cands = coschedule_candidates([a, b], self._choices(), 1.15)
+        assert len(cands) == 1
+        # fillable = min(1, 0.5 + 0.5) = 1.0 -> comb = max(10, 8, 0*10 + 8)
+        assert cands[0][2][0][2] == pytest.approx(10.0)
+
     def test_disjoint_options_never_pair(self):
         tech = RecordingTech()
         a = FakeTask("a", 10, [4], tech, hf=0.9)

@@ -265,3 +265,90 @@ def test_env_hbm_bytes_backstop(monkeypatch):
     assert spmd_base._env_hbm_bytes() == 123456
     monkeypatch.setenv(ml_passes.ENV_CAPACITY, "junk")
     assert spmd_base._env_hbm_bytes() == 0
+
+
+# ------------------------------------------------- pipeline stash residency
+class TestPipelineStashResidency:
+    """Round 20 (SAT-M regression): the staged pipeline's activation stash.
+
+    1F1B's whole memory claim is that the stash ring is ``min(M, 2S-1)``
+    deep — O(S), independent of the microbatch count — while the GPipe
+    ordering keeps all ``M`` in-flight inputs resident. The analytic model
+    (``ml_passes.pipeline_stash_bytes``) pins the formula; the traced check
+    holds the generic scan-carry liveness rule to the same delta, so a
+    liveness change that stops seeing the stash (or a schedule change that
+    silently grows it) breaks here before it mis-prices feasibility.
+    """
+
+    def test_analytic_model_bounds(self):
+        unit = 1024
+        S = 4
+        # 1F1B plateaus at 2S-1 = 7 stashed microbatches...
+        assert ml_passes.pipeline_stash_bytes("1f1b", S, 2, unit) == 2 * unit
+        assert ml_passes.pipeline_stash_bytes("1f1b", S, 8, unit) == 7 * unit
+        assert ml_passes.pipeline_stash_bytes("1f1b", S, 64, unit) == 7 * unit
+        # ...the GPipe ordering grows linearly in M
+        assert ml_passes.pipeline_stash_bytes("gpipe", S, 8, unit) == 8 * unit
+        assert (ml_passes.pipeline_stash_bytes("gpipe", S, 64, unit)
+                == 64 * unit)
+        for m in (2, 4, 8, 64):
+            assert (ml_passes.pipeline_stash_bytes("1f1b", S, m, unit)
+                    <= ml_passes.pipeline_stash_bytes("gpipe", S, m, unit))
+
+    def test_analytic_model_matches_ops_depth(self):
+        from saturn_tpu.ops.pipeline import stash_depth
+
+        for sched in ("1f1b", "gpipe"):
+            for s in (2, 4):
+                for m in (2, 7, 16):
+                    assert (ml_passes.pipeline_stash_bytes(sched, s, m, 3)
+                            == 3 * stash_depth(s, m, sched))
+
+    def test_traced_liveness_sees_the_stash_delta(self):
+        """At equal per-microbatch size, the traced peak gap between the two
+        staged schedules tracks the analytic stash delta (within the carry
+        in/out double-residency factor of the liveness model)."""
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from saturn_tpu.ops.pipeline import staged_pipeline_loss_and_grads
+
+        L, DM, V, T = 4, 16, 31, 12
+        key = jax.random.PRNGKey(0)
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        params = {
+            "emb": jax.random.normal(k1, (V, DM)) * 0.02,
+            "blocks": {
+                "w": jax.random.normal(k2, (L, DM, DM)) * 0.1,
+                "b": jnp.zeros((L, DM)),
+            },
+            "head": jax.random.normal(k3, (DM, V)) * 0.02,
+        }
+        d, S, M, B = 2, 4, 14, 56
+        devs = np.array(jax.devices()[:8]).reshape(d, S)
+        mesh = Mesh(devs, ("data", "stage"))
+        fns = dict(
+            mesh=mesh, block_key="blocks",
+            embed_fn=lambda o, t: o["emb"][t],
+            block_fn=lambda lp, h: jnp.tanh(h @ lp["w"] + lp["b"]),
+            head_fn=lambda o, h: h @ o["head"],
+            loss_fn=lambda lg, t: -jnp.mean(jnp.take_along_axis(
+                jax.nn.log_softmax(lg, axis=-1), t[..., None], axis=-1)),
+        )
+        tokens = jax.random.randint(k4, (B, T), 0, V)
+
+        def peak(schedule):
+            closed = jax.make_jaxpr(
+                lambda p, t: staged_pipeline_loss_and_grads(
+                    p, t, n_microbatches=M, schedule=schedule, **fns)
+            )(params, tokens)
+            in_specs = [_replicated(v.aval) for v in closed.jaxpr.invars]
+            return liveness.analyze_closed(closed, in_specs, {}).peak_bytes
+
+        gap = peak("gpipe") - peak("1f1b")
+        assert gap > 0, "1f1b must be the smaller traced peak at M > 2S-1"
+        # per-(stage, data)-shard stage-input microbatch: (B/d/M, T, DM) f32
+        unit = (B // d // M) * T * DM * 4
+        analytic = (ml_passes.pipeline_stash_bytes("gpipe", S, M, unit)
+                    - ml_passes.pipeline_stash_bytes("1f1b", S, M, unit))
+        assert 0.5 * analytic <= gap <= 4.0 * analytic, (gap, analytic)

@@ -199,6 +199,24 @@ class TestPersistentCache:
         # sizes with the 8-dev run (topology signature differs)
         assert len(CountingTech.calls) == 3
 
+    def test_schedule_set_change_misses(self, tmp_path, monkeypatch):
+        """Round 20: the pipeline schedule set is part of the fingerprint —
+        a profile recorded under a gpipe-only sweep must miss once 1F1B
+        joins the grid (execution would route cached configs differently)."""
+        cache_dir = str(tmp_path / "cache")
+        monkeypatch.setattr(pcache, "schedule_signature", lambda: "gpipe-only")
+        run_search([FakeTask("a")], ["counting"], cache_dir)
+        CountingTech.calls = []
+        monkeypatch.setattr(
+            pcache, "schedule_signature", lambda: "gpipe+1f1b:v1")
+        run_search([FakeTask("a")], ["counting"], cache_dir)
+        assert len(CountingTech.calls) == 4  # every size re-trialed
+
+    def test_schedule_signature_resolves_from_ops(self):
+        from saturn_tpu.ops.pipeline import SCHEDULE_SET_VERSION
+
+        assert pcache.schedule_signature() == SCHEDULE_SET_VERSION
+
     def test_corrupt_and_stale_entries_are_misses(self, tmp_path):
         cache_dir = str(tmp_path / "cache")
         run_search([FakeTask("a")], ["counting"], cache_dir)

@@ -1,0 +1,181 @@
+"""Pipeline schedule sweep + analytic bubble pricing (round 20).
+
+Hardware-free: ``candidate_configs`` and the bubble/stash formulas are pure
+Python, so the divisor stage sweep, the microbatch fallback, the
+``schedule`` grid dimension, and the cross-slice ``stage_major`` gating all
+get tier-1 coverage without compiling a single program (the staged programs
+themselves are pinned in ``tests/test_pipeline.py``'s slow suite).
+"""
+
+from typing import Optional
+
+import pytest
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.parallel.pp import Pipeline
+
+
+class FakeDev:
+    platform = "cpu"
+    device_kind = "fake-cpu"
+    process_index = 0
+
+
+class _Spec:
+    def __init__(self, n_layers):
+        self.hints = {"pipeline": True}
+        self.config = type("C", (), {"n_layers": n_layers})()
+        self.apply_with_aux_fn = None  # no aux loss: pp-compatible
+
+
+class _DS:
+    def __init__(self, batch_size):
+        self.batch_size = batch_size
+
+
+class _Task:
+    """candidate_configs-facing duck type: a model spec and a batch size."""
+
+    def __init__(self, n_layers, batch_size):
+        self._spec = _Spec(n_layers)
+        self._ds = _DS(batch_size)
+
+    def get_model(self, **kw):
+        return self._spec
+
+    def get_dataset(self):
+        return self._ds
+
+
+def _pp(topology: Optional[SliceTopology] = None) -> Pipeline:
+    pp = Pipeline()
+    if topology is not None:
+        pp.topology = topology
+    return pp
+
+
+# -------------------------------------------------------------- stage sweep
+def test_divisor_stage_sweep_covers_non_powers_of_two():
+    """A 6-device block admits s=2, s=3 AND s=6 — the old ``s <<= 1`` sweep
+    never proposed the odd divisors."""
+    grid = _pp().candidate_configs(_Task(n_layers=6, batch_size=24), 6)
+    assert sorted({c["stages"] for c in grid}) == [2, 3, 6]
+
+
+def test_stage_sweep_respects_layer_and_batch_limits():
+    # stages never exceed layers...
+    grid = _pp().candidate_configs(_Task(n_layers=2, batch_size=24), 8)
+    assert {c["stages"] for c in grid} == {2}
+    # ...and a data width that doesn't divide the batch is skipped
+    grid = _pp().candidate_configs(_Task(n_layers=8, batch_size=6), 8)
+    for c in grid:
+        d = 8 // c["stages"]
+        assert 6 % d == 0
+
+
+def test_schedule_is_a_grid_dimension():
+    grid = _pp().candidate_configs(_Task(n_layers=4, batch_size=16), 4)
+    assert {c["schedule"] for c in grid} == {"gpipe", "1f1b"}
+    # every config names its schedule explicitly — the trial runner times
+    # both and realized cost picks, nothing is implied by omission
+    assert all("schedule" in c for c in grid)
+
+
+# ------------------------------------------------------ microbatch fallback
+def test_microbatch_fallback_to_largest_divisor():
+    """per-replica batch 6 at s=2: the preferred (8, 4, 2) ladder hits 2,
+    but per-replica 9 at s=3 has no 12/6/3?  9 % 3 == 0 -> ladder works;
+    use per-replica 10 at s=4 where none of 16/8/4 divide: the fallback
+    finds the largest stage multiple that does."""
+    # s=4, d=1, per_replica=10: gpipe ladder (16, 8, 4) all fail; the
+    # stage-multiple fallback range (4, 8, 12, 16) also fails -> gpipe
+    # absent at s=4, and the 1f1b fallback picks the largest divisor of 10
+    # in [2, 16] -> 10.
+    grid = _pp().candidate_configs(_Task(n_layers=4, batch_size=10), 4)
+    four = [c for c in grid if c["stages"] == 4]
+    assert four, "s=4 must survive via the 1f1b fallback"
+    assert {c["schedule"] for c in four} == {"1f1b"}
+    assert {c["microbatches"] for c in four} == {10}
+    # s=2, d=2, per_replica=5: same story — gpipe has no multiple of 2
+    # dividing 5, 1f1b takes m=5.
+    two = [c for c in grid if c["stages"] == 2]
+    assert {c["schedule"] for c in two} == {"1f1b"}
+    assert {c["microbatches"] for c in two} == {5}
+
+
+def test_microbatch_stage_multiple_fallback_for_gpipe():
+    """s=4, per-replica 12: the (16, 8, 4) ladder hits 4 directly; but
+    per-replica 24 at s=4 prefers 16? 24 % 16 != 0 -> ladder gives 8.
+    The interesting case is per-replica 12 at s=6 (d=1): ladder (24, 12, 6)
+    -> 12 and 6 divide; both schedules keep M % S == 0 candidates."""
+    grid = _pp().candidate_configs(_Task(n_layers=6, batch_size=12), 6)
+    six = [c for c in grid if c["stages"] == 6]
+    for c in six:
+        assert c["microbatches"] % c["stages"] == 0
+
+
+# ------------------------------------------------- cross-slice stage layout
+def test_stage_major_layout_gated_on_cross_slice_topology():
+    task = _Task(n_layers=8, batch_size=16)
+    # no topology stamped -> never proposed
+    grid = _pp().candidate_configs(task, 8)
+    assert all("layout" not in c for c in grid)
+    # single-slice topology -> still never proposed
+    topo = SliceTopology([FakeDev() for _ in range(8)], slice_size=8)
+    grid = _pp(topo).candidate_configs(task, 8)
+    assert all("layout" not in c for c in grid)
+    # block larger than one slice -> stage_major rides along
+    topo = SliceTopology([FakeDev() for _ in range(8)], slice_size=4)
+    grid = _pp(topo).candidate_configs(task, 8)
+    layouts = {c.get("layout") for c in grid}
+    assert layouts == {None, "stage_major"}
+
+
+def test_stage_major_mesh_puts_stage_on_the_leading_axis():
+    """stage_major flips the mesh so the stage axis is LEADING — with
+    slice-major device order that is the axis whose hops cross slices, and
+    shardflow's ``crossing_axes`` then prices stage ppermutes at DCN rate."""
+    pp = _pp()
+    axes, shape = pp.mesh_spec(8, None, {"stages": 4, "layout": "stage_major"})
+    assert axes == ("stage", "data")
+    assert shape == (4, 2)
+    axes, shape = pp.mesh_spec(8, None, {"stages": 4})
+    assert axes == ("data", "stage")
+    assert shape == (2, 4)
+
+
+# ------------------------------------------------------------ bubble pricing
+def test_bubble_fraction_formulas():
+    from saturn_tpu.ops.pipeline import schedule_bubble_fraction
+
+    # GPipe: (S-1)/(M+S-1); 1F1B: (S-1)/(M+2(S-1))
+    assert schedule_bubble_fraction("gpipe", 4, 4) == pytest.approx(3 / 7)
+    assert schedule_bubble_fraction("1f1b", 4, 4) == pytest.approx(3 / 10)
+    # 1F1B's bubble is never larger, and strictly smaller for S >= 2
+    for s in (2, 3, 4, 8):
+        for m in (s, 2 * s, 4 * s):
+            g = schedule_bubble_fraction("gpipe", s, m)
+            f = schedule_bubble_fraction("1f1b", s, m)
+            assert f < g
+    # degenerate single stage: no bubble either way
+    assert schedule_bubble_fraction("gpipe", 1, 4) == 0.0
+    assert schedule_bubble_fraction("1f1b", 1, 4) == 0.0
+
+
+def test_config_bubble_fraction_reads_the_config():
+    pp = _pp()
+    gp = pp.config_bubble_fraction({"stages": 4, "microbatches": 8})
+    f1 = pp.config_bubble_fraction(
+        {"stages": 4, "microbatches": 8, "schedule": "1f1b"})
+    assert gp == pytest.approx(3 / 11)   # schedule defaults to gpipe
+    assert f1 == pytest.approx(3 / 14)
+    assert f1 < gp
+
+
+def test_base_technique_bubble_is_zero():
+    """Non-pipeline techniques have no schedule bubble: the base hook the
+    evaluator calls at install time must return 0.0, keeping Strategy's
+    default and the solver's host-only fillable fraction unchanged."""
+    from saturn_tpu.parallel.dp import DataParallel
+
+    assert DataParallel().config_bubble_fraction({"remat": True}) == 0.0
