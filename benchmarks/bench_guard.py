@@ -135,9 +135,15 @@ def bench_memlens_errors() -> list:
     Returns error diagnostics (JSON form); sanctioned findings are info
     and pass.
     """
+    # The source tree is where THIS file lives, not REPO: REPO is the
+    # record-lookup root and tests point it at a tmp dir, which must not
+    # break the subprocess's ability to import saturn_tpu.
+    src = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = src + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run(
         [sys.executable, "-m", "saturn_tpu.analysis", "--json", "memlens"],
-        capture_output=True, text=True, timeout=900, cwd=REPO,
+        capture_output=True, text=True, timeout=900, cwd=REPO, env=env,
     )
     if r.returncode == 2:
         raise RuntimeError(
@@ -564,6 +570,80 @@ def validate_ckpt_row(row, reference=None, pct=10.0) -> list:
             problems.append(
                 f"sharded_save_s {new_s} regressed >{pct}% vs recorded "
                 f"{ref_s}"
+            )
+    return problems
+
+
+#: Required key -> type for the ``benchmarks/fused_sweep.py`` row. Same
+#: contract as the other ROW_REQUIRED tables: the bench self-validates
+#: before printing, and recorded rows can be re-checked without re-running.
+FUSED_ROW_REQUIRED = {
+    "metric": str,                     # "fused_sweep_tokens_per_sec"
+    "workload": str,                   # "fused_sweep"
+    "platform": str,
+    "n_members": int,                  # >= 2 or there is no stack
+    "batches_per_member": int,
+    "batch_size": int,
+    "seq_len": int,
+    "window": int,
+    "value": float,                    # fused aggregate tokens/sec
+    "coscheduled_tokens_per_sec": float,
+    "fused_s": float,
+    "coscheduled_s": float,
+    "speedup_vs_coschedule": float,    # acceptance bar: >= 1.0
+    "loss_divergence": float,          # max |fused - solo ref|: ~0 required
+    "status": str,
+}
+
+#: The fused row's per-member losses are compared after the event stream's
+#: 6-decimal rounding, so bit-identical trajectories read back as <= 1e-6
+#: apart; anything past this tolerance means the stacked program changed
+#: the math, and the row is a lie about "the same training, faster".
+FUSED_LOSS_TOL = 1e-5
+
+
+def validate_fused_row(row) -> list:
+    """Schema-check one fused-sweep row; returns human-readable problems
+    (empty list = valid). Refuses rows whose speedup claim is measured
+    against diverged members: ``loss_divergence`` past FUSED_LOSS_TOL means
+    the fused trajectories are not the solo trajectories."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in FUSED_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "fused_sweep_tokens_per_sec":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected "
+            "'fused_sweep_tokens_per_sec'"
+        )
+    n = row.get("n_members")
+    if isinstance(n, int) and not isinstance(n, bool) and n < 2:
+        problems.append(f"n_members {n} < 2 (no stack to fuse)")
+    sp = row.get("speedup_vs_coschedule")
+    if isinstance(sp, (int, float)) and not isinstance(sp, bool) and sp < 1.0:
+        problems.append(
+            f"speedup_vs_coschedule {sp} < 1.0 (the stack must beat the "
+            "co-scheduled pairs it replaces)"
+        )
+    div = row.get("loss_divergence")
+    if isinstance(div, (int, float)) and not isinstance(div, bool):
+        if not div <= FUSED_LOSS_TOL:
+            problems.append(
+                f"loss_divergence {div} > {FUSED_LOSS_TOL} (a fused member's "
+                "final loss diverged from its solo reference — refusing to "
+                "record a speedup over different training)"
             )
     return problems
 

@@ -1,0 +1,216 @@
+"""Fused multi-model stacking microbenchmark: 8-member sweep, one program.
+
+Round 21's tentpole claim, measured end-to-end through the engine: N sweep
+jobs that differ only in learning rate (same test-tiny GPT-2, same batch
+shape, same optimizer family) train as ONE compiled SPMD program — params
+and optimizer state stacked along a leading ``model`` axis, the step
+function vmapped over it, per-member LR passed as a stacked array
+(``parallel/fused.py``). The baseline is the pre-round-21 best for the same
+sweep: co-scheduled pairs, each pair interleaving its solo programs on a
+shared block.
+
+Prints ONE JSON line (self-validated by ``bench_guard.validate_fused_row``
+before printing — a row whose fused members diverged from their solo
+references is refused, not recorded):
+
+    {"metric": "fused_sweep_tokens_per_sec", "value": <fused aggregate>,
+     "workload": "fused_sweep", "n_members": 8,
+     "coscheduled_tokens_per_sec": ..., "speedup_vs_coschedule": ...,
+     "loss_divergence": 0.0, ...}
+
+``workload`` makes the row shape-distinct for ``bench_guard.py``: a fused
+record never gates a ``bench.py`` record or vice versa.
+
+Hardware-free by construction (CPU forced before jax imports) and sized for
+a one-core CI host: at toy model sizes per-program dispatch overhead
+dominates, which is exactly the regime the paper's sweep workloads live in
+— N tiny programs pay N dispatch/readback pipelines, the stack pays one.
+The members' loss trajectories are REQUIRED to match their co-scheduled
+(= solo-program) references: the speedup must come from stacking, never
+from changing the math. Run: ``python benchmarks/fused_sweep.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import timeit
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from benchmarks.bench_guard import validate_fused_row
+from saturn_tpu import HParams, Task
+from saturn_tpu.core.mesh import Block, SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.data.lm_dataset import make_lm_dataset
+from saturn_tpu.executor import engine
+from saturn_tpu.models.gpt2 import build_gpt2
+from saturn_tpu.models.loss import pretraining_loss
+from saturn_tpu.parallel import fused
+from saturn_tpu.parallel.dp import DataParallel
+from saturn_tpu.solver.milp import Assignment, Plan
+from saturn_tpu.utils import checkpoint as ckpt
+from saturn_tpu.utils import metrics
+
+SEQ_LEN = 16
+BATCH_SIZE = 1
+N_MEMBERS = 8
+N_BATCHES = 24          # per member; every member retires all of them
+WINDOW = 8
+
+
+def make_member(save_root: str, i: int) -> Task:
+    def loader():
+        return make_lm_dataset(
+            context_length=SEQ_LEN, batch_size=BATCH_SIZE, vocab_size=256,
+            n_tokens=SEQ_LEN * BATCH_SIZE * 32,
+        )
+
+    t = Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", seq_len=SEQ_LEN, **kw),
+        get_dataloader=loader,
+        loss_fn=pretraining_loss,
+        # LR is the sweep axis: it rides along as a stacked hparam and is
+        # excluded from the fusion fingerprint, so all N members fuse.
+        hparams=HParams(lr=1e-3 * (1.0 + 0.05 * i), batch_count=N_BATCHES),
+        chip_range=[1],
+        name=f"sweep{i}",
+        save_dir=os.path.join(save_root, f"sweep{i}"),
+    )
+    t.strategies = {
+        1: Strategy(executor=DataParallel(), apportionment=1, params={},
+                    runtime=1.0, per_batch_time=0.01)
+    }
+    return t
+
+
+def run_coscheduled_pairs(tmp: str, metrics_path: str) -> float:
+    """Baseline arm: the sweep as 4 co-scheduled pairs, each pair
+    interleaving its two solo programs on its own one-device block."""
+    members = [make_member(os.path.join(tmp, "cos"), i)
+               for i in range(N_MEMBERS)]
+    assignments = {}
+    groups = []
+    for p in range(N_MEMBERS // 2):
+        a, b = members[2 * p], members[2 * p + 1]
+        for t in (a, b):
+            assignments[t.name] = Assignment(1, Block(p, 1), 0.0, 1.0)
+        groups.append([a.name, b.name])
+    plan = Plan(assignments=assignments, makespan=1.0, coschedule=groups)
+    plan.compute_dependencies()
+    topo = SliceTopology(jax.devices())
+    # warm every pair's programs outside the timed region (compile tax is
+    # not the thing under test)
+    for t in members:
+        tech = t.strategies[1].executor
+        block = plan.assignments[t.name].block
+        bundle = tech.build(t, topo.block_devices(block), {})
+        bundle.fused_compiled(WINDOW)
+        _ = bundle.compiled
+    batches = {t.name: N_BATCHES for t in members}
+    with metrics.scoped(metrics_path):
+        t0 = timeit.default_timer()
+        errors = engine.execute(members, batches, 300.0, plan, topo)
+        dt = timeit.default_timer() - t0
+    if errors:
+        raise RuntimeError(f"co-scheduled arm failed: {errors}")
+    return dt
+
+
+def run_fused_stack(tmp: str, metrics_path: str) -> float:
+    """Fused arm: the whole sweep as one stacked program through the
+    engine's fused launcher (``Plan.fused`` group)."""
+    members = [make_member(os.path.join(tmp, "fus"), i)
+               for i in range(N_MEMBERS)]
+    assignments = {
+        t.name: Assignment(1, Block(0, 1), 0.0, 1.0) for t in members
+    }
+    plan = Plan(assignments=assignments, makespan=1.0,
+                fused=[[t.name for t in members]])
+    plan.compute_dependencies()
+    topo = SliceTopology(jax.devices())
+    # warm the stacked program outside the timed region
+    devices = topo.block_devices(Block(0, 1))
+    prog = fused.build_fused_program(members, devices)
+    prog.window_compiled(WINDOW)
+    prog.single_compiled()
+    batches = {t.name: N_BATCHES for t in members}
+    with metrics.scoped(metrics_path):
+        t0 = timeit.default_timer()
+        errors = engine.execute(members, batches, 300.0, plan, topo)
+        dt = timeit.default_timer() - t0
+    if errors:
+        raise RuntimeError(f"fused arm failed: {errors}")
+    return dt
+
+
+def read_losses(metrics_path: str) -> dict:
+    """Per-member final losses, from either arm's event stream (solo
+    programs emit ``task_interval``, the stack emits ``fused_interval``),
+    rounded alike so the divergence check compares like with like."""
+    losses: dict = {}
+    for ev in metrics.read_events(metrics_path, kind="task_interval"):
+        losses[ev["task"]] = round(float(ev["loss"]), 6)
+    for ev in metrics.read_events(metrics_path, kind="fused_interval"):
+        for name, v in (ev.get("losses") or {}).items():
+            losses[name] = round(float(v), 6)
+    return losses
+
+
+def main() -> int:
+    os.environ.setdefault("SATURN_TPU_MAX_WINDOW", str(WINDOW))
+    with tempfile.TemporaryDirectory() as tmp:
+        cos_events = os.path.join(tmp, "cos.jsonl")
+        fus_events = os.path.join(tmp, "fus.jsonl")
+        t_cos = run_coscheduled_pairs(tmp, cos_events)
+        t_fus = run_fused_stack(tmp, fus_events)
+        solo_losses = read_losses(cos_events)
+        fused_losses = read_losses(fus_events)
+        # drain async checkpoint writers before the tmp dir disappears
+        ckpt.flush()
+    divergence = max(
+        abs(fused_losses.get(f"sweep{i}", float("inf"))
+            - solo_losses.get(f"sweep{i}", float("-inf")))
+        for i in range(N_MEMBERS)
+    )
+    total_tokens = N_MEMBERS * N_BATCHES * BATCH_SIZE * SEQ_LEN
+    out = {
+        "metric": "fused_sweep_tokens_per_sec",
+        "value": round(total_tokens / t_fus, 1),
+        "workload": "fused_sweep",
+        "platform": jax.devices()[0].platform,
+        "n_members": N_MEMBERS,
+        "batches_per_member": N_BATCHES,
+        "batch_size": BATCH_SIZE,
+        "seq_len": SEQ_LEN,
+        "window": WINDOW,
+        "coscheduled_tokens_per_sec": round(total_tokens / t_cos, 1),
+        "fused_s": round(t_fus, 3),
+        "coscheduled_s": round(t_cos, 3),
+        "speedup_vs_coschedule": round(t_cos / t_fus, 3),
+        "loss_divergence": divergence,
+        "status": "ok",
+    }
+    problems = validate_fused_row(out)
+    if problems:
+        out["status"] = "invalid"
+        out["problems"] = problems
+        print(json.dumps(out))
+        return 1
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

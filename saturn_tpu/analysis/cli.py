@@ -27,6 +27,10 @@ Subcommands:
   ``solver_tier`` events from a metrics stream — per-tier adoption counts,
   wall-time p50/p99 vs deadline, deadline misses (must be zero in a
   healthy run), fallback (greedy) frequency, and mean quality ratio.
+- ``fusion METRICS.jsonl``: summarize fused-stack events from a metrics
+  stream — per-group membership and lockstep throughput
+  (``fused_interval``), unfuse events with the interval step each member
+  left at (``fused_unfuse``), and fused-trial pricing (``trial_fused``).
 - ``shardflow``: saturn-shardflow's jaxpr-level sharding-propagation pass
   over every in-tree technique — traces each step function on virtual CPU
   devices (no chip), propagates PartitionSpecs through every equation, and
@@ -501,6 +505,91 @@ def _cmd_solver(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_fusion(args: argparse.Namespace) -> int:
+    from saturn_tpu.utils import metrics
+
+    try:
+        intervals = metrics.read_events(args.path, kind="fused_interval")
+        unfuses = metrics.read_events(args.path, kind="fused_unfuse")
+        trials = metrics.read_events(args.path, kind="trial_fused")
+    except OSError as e:
+        print(f"cannot read metrics at {args.path!r}: {e}", file=sys.stderr)
+        return 2
+
+    groups: dict = {}
+    for ev in intervals:
+        key = tuple(ev.get("members") or [])
+        row = groups.setdefault(key, {
+            "members": list(key), "intervals": 0, "batches": 0,
+            "per_step_s": [], "samples_per_sec": [],
+            "detached": [], "faulted": [],
+        })
+        row["intervals"] += 1
+        row["batches"] += int(ev.get("batches", 0))
+        row["per_step_s"].append(float(ev.get("per_step_s", 0.0)))
+        row["samples_per_sec"].append(float(ev.get("samples_per_sec", 0.0)))
+        row["detached"].extend(ev.get("detached") or [])
+        row["faulted"].extend(ev.get("faulted") or [])
+    unfuse_rows = [
+        {"task": ev.get("task"), "group": ev.get("group"),
+         "step": ev.get("step"), "n_remaining": ev.get("n_remaining")}
+        for ev in unfuses
+    ]
+    trial_rows = [
+        {"tasks": ev.get("tasks"), "size": ev.get("size"),
+         "feasible": ev.get("feasible"),
+         "per_step_s": ev.get("per_step_s")}
+        for ev in trials
+    ]
+
+    payload = {
+        "groups": [
+            {
+                "members": row["members"],
+                "intervals": row["intervals"],
+                "lockstep_batches": row["batches"],
+                "per_step_p50_s": round(
+                    _percentile(row["per_step_s"], 0.50), 6),
+                "samples_per_sec_last": (
+                    row["samples_per_sec"][-1]
+                    if row["samples_per_sec"] else 0.0),
+                "detached": row["detached"],
+                "faulted": sorted(set(row["faulted"])),
+            }
+            for row in groups.values()
+        ],
+        "unfuse_events": unfuse_rows,
+        "fused_trials": trial_rows,
+    }
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+        return 0
+    if not (groups or unfuse_rows or trial_rows):
+        print(f"{args.path}: no fusion events "
+              "(fused_interval / fused_unfuse / trial_fused)")
+        return 0
+    for row in payload["groups"]:
+        print(f"group {'+'.join(row['members'])}: "
+              f"{row['intervals']} interval(s), "
+              f"{row['lockstep_batches']} lockstep batch(es), "
+              f"per-step p50 {row['per_step_p50_s']:.4f}s, "
+              f"last {row['samples_per_sec_last']:.1f} samples/s")
+        if row["detached"]:
+            print(f"  detached: {', '.join(row['detached'])}")
+        if row["faulted"]:
+            print(f"  faulted: {', '.join(row['faulted'])}")
+    for ev in unfuse_rows:
+        print(f"unfuse: {ev['task']} left {ev['group']} at interval step "
+              f"{ev['step']} ({ev['n_remaining']} member(s) remained)")
+    for ev in trial_rows:
+        verdict = (f"{ev['per_step_s']:.4f}s/lockstep step"
+                   if ev.get("feasible") and ev.get("per_step_s") is not None
+                   else "infeasible")
+        print(f"trial: {'+'.join(ev['tasks'] or [])} @ size {ev['size']}: "
+              f"{verdict}")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m saturn_tpu.analysis",
@@ -561,6 +650,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     s.add_argument("path")
     s.set_defaults(fn=_cmd_solver)
+
+    f = sub.add_parser(
+        "fusion",
+        help="summarize fused-stack events from a metrics JSONL: group "
+             "membership, lockstep throughput, unfuse events, fused trials",
+    )
+    f.add_argument("path")
+    f.set_defaults(fn=_cmd_fusion)
 
     x = sub.add_parser(
         "shardflow",

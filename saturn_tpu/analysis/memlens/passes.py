@@ -321,6 +321,61 @@ def grid_point_infeasible(
     return True
 
 
+def fused_stack_fits(
+    tech: Any,
+    task: Any,
+    devices: Sequence[Any],
+    n_members: int,
+    capacity_bytes: Optional[int] = None,
+    config: Optional[Dict[str, Any]] = None,
+    max_configs: int = 3,
+) -> Optional[bool]:
+    """Zero-compile residency prior for an N-member fused stack.
+
+    The stacked program shards its leading ``model`` axis across the block's
+    devices (``parallel/fused.py``), so each device is resident for
+    ``ceil(N / n_dev)`` members' FULL solo state — stacking multiplies the
+    single-device peak rather than resharding it. This charges that product
+    against the OOM margin and answers the solver's ``fusion_fits`` contract
+    (``solver/milp.fusion_priced_groups``):
+
+    - ``False``: the cheapest traceable config's stacked peak statically
+      clears the OOM margin — certain not to fit, vetoes the size.
+    - ``True``: the stacked peak fits under the margin.
+    - ``None``: no safe verdict (capacity unknown, nothing traceable) —
+      never prunes; the compile-time backstop decides.
+
+    ``n_dev`` honors the fused program's divisibility walk: the model axis
+    only spans a device count that divides N evenly, falling back by powers
+    of two (worst case one device carries the whole vmapped stack).
+    """
+    cap = (hbm_capacity_bytes(devices) if capacity_bytes is None
+           else int(capacity_bytes))
+    if cap <= 0 or int(n_members) < 2 or not hasattr(tech, "trace_step"):
+        return None
+    n_dev = max(len(devices), 1)
+    while n_dev > 1 and int(n_members) % n_dev != 0:
+        n_dev //= 2
+    members_per_dev = -(-int(n_members) // n_dev)
+    grid: List[Dict[str, Any]]
+    if config is not None:
+        grid = [dict(config)]
+    else:
+        try:
+            grid = list(tech.candidate_configs(task, 1))
+        except Exception:
+            return None
+        grid = grid[:max_configs]
+    peaks: List[int] = []
+    for cfg in grid:
+        prof = predict_profile(tech, task, list(devices)[:1], cfg)
+        if prof is not None:
+            peaks.append(int(prof.peak_bytes))
+    if not peaks:
+        return None
+    return bool(members_per_dev * min(peaks) <= OOM_MARGIN * cap)
+
+
 def coldstart_verdict(
     task: Any, topology: Any,
     techniques: Optional[Dict[str, Any]] = None,

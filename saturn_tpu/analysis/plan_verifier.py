@@ -40,9 +40,10 @@ from saturn_tpu.analysis.diagnostics import (
 # ---------------------------------------------------------------------------
 
 def coschedule_find(names: Iterable[str], plan: Any) -> Callable[[str], str]:
-    """Union-find root function over the plan's co-schedule groups,
-    restricted to ``names``.  Members of one group are one condensed node:
-    they run interleaved on one shared launcher, so ordering and race
+    """Union-find root function over the plan's co-schedule AND fusion
+    groups, restricted to ``names``.  Members of one group are one condensed
+    node: co-schedule members run interleaved on one shared launcher and
+    fusion members run as ONE stacked program, so ordering and race
     properties are checked between groups, never inside one.  Groups that
     share a member merge (one launcher must own a task).
 
@@ -58,12 +59,13 @@ def coschedule_find(names: Iterable[str], plan: Any) -> Callable[[str], str]:
             n = parent[n]
         return n
 
-    for grp in getattr(plan, "coschedule", None) or []:
-        members = [n for n in grp if n in running]
-        for a, b in zip(members, members[1:]):
-            ra, rb = find(a), find(b)
-            if ra != rb:
-                parent[ra] = rb
+    for attr in ("coschedule", "fused"):
+        for grp in getattr(plan, attr, None) or []:
+            members = [n for n in grp if n in running]
+            for a, b in zip(members, members[1:]):
+                ra, rb = find(a), find(b)
+                if ra != rb:
+                    parent[ra] = rb
     return find
 
 
@@ -348,6 +350,35 @@ def _structure_diagnostics(plan: Any) -> List[Diagnostic]:
                 counterexample={"group": gi, "members": list(grp)},
                 category="structure",
             ))
+    seen_fused: Dict[str, int] = {}
+    for gi, grp in enumerate(getattr(plan, "fused", None) or []):
+        for m in grp:
+            if m not in known:
+                out.append(make(
+                    "SAT-P014", "warning",
+                    f"fusion group {gi} names unknown task {m!r}",
+                    counterexample={"group": gi, "unknown": m},
+                    category="structure",
+                ))
+            if m in seen_fused and seen_fused[m] != gi:
+                out.append(make(
+                    "SAT-P016", "warning",
+                    f"task {m!r} appears in fusion groups {seen_fused[m]} "
+                    f"and {gi} — one task can belong to only one stacked "
+                    "program",
+                    counterexample={"task": m,
+                                    "groups": [seen_fused[m], gi]},
+                    category="structure",
+                ))
+            seen_fused.setdefault(m, gi)
+        if len([m for m in grp if m in known]) < 2:
+            out.append(make(
+                "SAT-P015", "warning",
+                f"fusion group {gi} has fewer than two assigned members — "
+                "nothing to stack",
+                counterexample={"group": gi, "members": list(grp)},
+                category="structure",
+            ))
     return out
 
 
@@ -423,6 +454,38 @@ def _feasibility_diagnostics(plan: Any, topology: Any,
                     f"co-scheduled task {m!r} has no measured host fraction "
                     "or schedule bubble at its apportionment — the "
                     "co-location term had no idle window to fill",
+                    counterexample={"task": m, "group": gi,
+                                    "apportionment": a.apportionment},
+                    category="feasibility",
+                ))
+    for gi, grp in enumerate(getattr(plan, "fused", None) or []):
+        assigned = [(m, plan.assignments[m]) for m in grp
+                    if m in plan.assignments]
+        slots = {(a.apportionment, a.block.offset, a.block.size, a.start)
+                 for _, a in assigned}
+        if len(slots) > 1:
+            out.append(make(
+                "SAT-P025", "error",
+                f"fusion group {gi} members do not hold IDENTICAL "
+                "(size, block, start) assignments — a stacked program is "
+                "one compiled step on one sub-mesh; divergent slots would "
+                "dispatch the same stack twice",
+                counterexample={"group": gi, "slots": sorted(slots)},
+                category="feasibility",
+            ))
+        for m, a in assigned:
+            t = by_name.get(m)
+            if t is None:
+                continue
+            strat = getattr(t, "strategies", {}).get(a.apportionment)
+            fpbt = getattr(strat, "fused_per_batch_time", None) if strat else None
+            if fpbt is None:
+                out.append(make(
+                    "SAT-P026", "warning",
+                    f"fused task {m!r} has no measured fused_per_batch_time "
+                    "at its apportionment — the fusion pre-pass prices "
+                    "strictly on measured lockstep cost, so this group was "
+                    "fused on guesswork",
                     counterexample={"task": m, "group": gi,
                                     "apportionment": a.apportionment},
                     category="feasibility",

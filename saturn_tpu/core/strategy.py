@@ -79,6 +79,16 @@ class Strategy:
     # what pre-existing cache entries report) predicts no overlap win, so a
     # strategy without a measurement is never co-scheduled.
     host_fraction: float = field(default=0.0)
+    # Seconds per LOCKSTEP step of a fused stack this task belongs to —
+    # every member of the stack advances one batch per lockstep step — as
+    # measured by the trial runner's fused-group profile
+    # (``trial_runner/evaluator.profile_fused_group``). None means the fused
+    # program was never profiled at this (task, size) point, and the solver
+    # must not fuse on guesswork: fusion is priced strictly on measured cost
+    # (``solver/milp.solve``), exactly like every other grid point. Updated
+    # by realized fused-interval feedback (EWMA, the
+    # ``apply_realized_feedback`` pattern) via the engine's fused launcher.
+    fused_per_batch_time: Optional[float] = field(default=None)
     # Analytic schedule-bubble fraction of a steady-state step, in [0, 1):
     # device-idle time (pipeline warmup/cooldown) a co-scheduled partner's
     # device windows could fill. Recomputed from ``params`` by every install

@@ -38,6 +38,33 @@ _CLOSE_JOIN_S = 5.0
 NOT_READY = object()
 
 
+class ShapeContractError(ValueError):
+    """A staged value violates the prefetcher's declared shape contract.
+
+    For fused groups the contract is the stacked ``(N, batch, seq)`` /
+    ``(K, N, batch, seq)`` window (``ops/stacking.py``); the per-MEMBER
+    mismatch inside a stack is already attributed by
+    ``stacking.stack_member_batches`` (it raises ``MemberShapeError`` naming
+    the exact task id), so reaching here means the stack as a whole — or a
+    solo batch — came out the wrong shape for the compiled program.
+    """
+
+    def __init__(self, unit: int, got, expect, member_names=None):
+        self.unit = unit
+        self.got = got
+        self.expect = expect
+        self.member_names = list(member_names) if member_names else None
+        who = (
+            f" (fused group of {len(self.member_names)}: "
+            f"{self.member_names})" if self.member_names else ""
+        )
+        super().__init__(
+            f"staged unit {unit} has shape {got}, expected one of "
+            f"{list(expect)}{who} — the staging callback and the compiled "
+            f"program disagree on the batch layout"
+        )
+
+
 class DevicePrefetcher:
     """Iterate device-staged values produced by a background thread.
 
@@ -56,11 +83,33 @@ class DevicePrefetcher:
     killed interval never leaks a producer that keeps slicing batches from a
     task the harness is rolling back. Consuming every item closes
     implicitly.
+
+    **Staged-shape contract.** A staged value's leading dims are whatever
+    the compiled program was lowered for: ``(batch, seq)`` per-step,
+    ``(K, batch, seq)`` for a solo fused window, and for a FUSED GROUP the
+    stacked forms ``(N, batch, seq)`` / ``(K, N, batch, seq)`` with the
+    member axis explicit. Pass ``expect_shapes`` (the allowed shapes) and
+    ``member_names`` (stack order) and the producer validates every staged
+    value BEFORE hand-off, raising :class:`ShapeContractError` that names
+    the offending member instead of the opaque XLA arity/shape error the
+    consumer's compiled call would produce.
     """
 
-    def __init__(self, n: int, stage: Callable[[int], Any], depth: int = 2):
+    def __init__(
+        self,
+        n: int,
+        stage: Callable[[int], Any],
+        depth: int = 2,
+        expect_shapes: Any = None,
+        member_names: Any = None,
+    ):
         self.n = int(n)
         self._stage = stage
+        self._expect = (
+            tuple(tuple(int(d) for d in s) for s in expect_shapes)
+            if expect_shapes else None
+        )
+        self._member_names = list(member_names) if member_names else None
         self._q: "queue.Queue" = tsan.make_queue(
             "prefetch.q", maxsize=max(1, int(depth))
         )
@@ -71,12 +120,24 @@ class DevicePrefetcher:
         )
         self._thread.start()
 
+    def _check_shape(self, i: int, item: Any) -> None:
+        """Enforce the staged-shape contract (no-op when ``expect_shapes``
+        was not given). Runs on the producer thread so the attributable
+        error crosses to the consumer through the normal error channel."""
+        if self._expect is None:
+            return
+        shape = tuple(getattr(item, "shape", ()) or ())
+        if shape in self._expect:
+            return
+        raise ShapeContractError(i, shape, self._expect, self._member_names)
+
     def _produce(self) -> None:
         try:
             for i in range(self.n):
                 if self._closed.is_set():
                     return
                 item = self._stage(i)
+                self._check_shape(i, item)
                 if not self._offer(("ok", item)):
                     return
         except BaseException as e:  # SimulatedKill must cross the thread

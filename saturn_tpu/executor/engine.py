@@ -166,12 +166,36 @@ def _coschedule_groups(run_tasks, plan) -> List[List]:
     """The co-schedule groups actually launching this interval: lists of
     Task objects (>= 2 running members each), one shared launcher per list.
     Tasks not in any group (or whose groupmates aren't running this
-    interval) launch on the normal per-task path."""
+    interval) launch on the normal per-task path.
+
+    Callers pass ``run_tasks`` with fusion-group members already removed
+    (:func:`_fused_groups` claims them first): the condensed union-find
+    merges fused groups too, so leaving them in would hand a stacked group
+    to the interleaving launcher."""
     find = _coschedule_find(run_tasks, plan)
     by_root: Dict[str, List] = {}
     for t in run_tasks:
         by_root.setdefault(find(t.name), []).append(t)
     return [g for g in by_root.values() if len(g) >= 2]
+
+
+def _fused_groups(run_tasks, plan) -> List[List]:
+    """The fusion groups actually launching this interval: lists of Task
+    objects (>= 2 running members each, in the plan's stack order), one
+    stacked program per list (``parallel/fused.run_fused_interval``). A
+    group whose running membership shrank below 2 degenerates to the normal
+    per-task path — a stack of one is just the solo program with an extra
+    axis."""
+    by_name = {t.name: t for t in run_tasks}
+    out: List[List] = []
+    claimed: set = set()
+    for grp in getattr(plan, "fused", None) or []:
+        members = [by_name[n] for n in grp
+                   if n in by_name and n not in claimed]
+        if len(members) >= 2:
+            out.append(members)
+            claimed.update(t.name for t in members)
+    return out
 
 
 def _join_with_watchdog(watch, t0, hung, hung_lock, errors, events) -> None:
@@ -640,8 +664,157 @@ def execute(
             for t in members:
                 events[t.name].set()
 
-    co_groups = _coschedule_groups(run_tasks, plan)
-    grouped = {t.name for g in co_groups for t in g}
+    def fused_launcher(members: List, tids: List[int]):
+        """One launcher for a fusion group: N members, ONE stacked program.
+
+        Unlike the co-schedule launcher — which interleaves N independent
+        programs on a shared block — the whole group here is a single
+        compiled step (``parallel/fused.run_fused_interval``): params and
+        optimizer state stacked along a leading ``model`` axis, every member
+        advancing one batch per lockstep step. Per-member outcomes come back
+        in the interval report:
+
+        - healthy members commit like the solo launcher (cursor advance,
+          realized fused-lockstep feedback EWMA'd into
+          ``Strategy.fused_per_batch_time``, ``on_task_done``); a member
+          whose forecast budget exceeded the lockstep count gets the
+          shortfall rolled back (:func:`rollback_forecast`) so the next
+          re-solve prices the truth;
+        - a sentinel-faulted member surfaces exactly like a solo numeric
+          fault (state discarded, error recorded, guardian owns recovery);
+        - a DETACHED member (mid-interval unfuse) resumes SOLO on the same
+          block for its remaining budget within this interval — the stack
+          already checkpointed its state at the detach boundary, so the solo
+          program restores bit-identically and no step is lost or repeated.
+        """
+        sched_point("engine.fused_launcher")
+        names = {t.name for t in members}
+        from saturn_tpu.parallel import fused as _fused
+
+        try:
+            for t in members:
+                for dep in plan.dependencies.get(t.name, ()):
+                    if dep in running and dep not in names:
+                        events[dep].wait()
+            a = plan.assignments[members[0].name]
+            devices = topology.block_devices(a.block)
+            didx = health.indices_of(devices) if health is not None else []
+            for t in members:
+                if faults is not None and faults.crashes(
+                    t.name, interval_index
+                ):
+                    raise RuntimeError(
+                        f"injected transient trial crash for {t.name}"
+                    )
+            if abort.is_set() or (didx and health.any_lost(didx)):
+                raise PreemptedError(
+                    f"fused group {sorted(names)} preempted before launch "
+                    f"(block [{a.block.offset}:{a.block.end}])"
+                )
+            for t in members:
+                t.select_strategy(a.apportionment)
+                if on_task_start is not None:
+                    on_task_start(t.name)
+                _set_poison(t.name, t)
+            if _stall_then_check(members[0].name):
+                return  # whole group abandoned during the stall
+            counts = [batches[t.name] for t in members]
+            logger.info(
+                "interval: fused-launching %s on block [%d:%d] "
+                "(lockstep %d batches x %d members)",
+                sorted(names), a.block.offset, a.block.end,
+                min(counts), len(members),
+            )
+            report = _fused.run_fused_interval(
+                members, devices, tids[0], batch_counts=counts,
+            )
+            if any(_abandoned(t.name) for t in members):
+                logger.warning(
+                    "fused group %s finished after watchdog abandonment; "
+                    "discarding the attempt", sorted(names),
+                )
+                return
+            if didx and health.any_lost(didx):
+                raise PreemptedError(
+                    f"fused group {sorted(names)} lost devices mid-run "
+                    f"(block [{a.block.offset}:{a.block.end}])"
+                )
+            detached = {t.name: s for t, s in report.detached}
+            if didx and report.per_step_s > 0:
+                health.note_step(didx, report.per_step_s)
+            for t in members:
+                name = t.name
+                mr = report.members.get(name)
+                if mr is None:
+                    continue
+                try:
+                    if mr.fault is not None:
+                        raise mr.fault
+                    budget = batches[name]
+                    steps = mr.steps
+                    if name in detached:
+                        remaining = max(0, budget - steps)
+                        if remaining > 0:
+                            tech = t.selected_strategy.executor
+                            logger.info(
+                                "interval: resuming unfused %s solo for %d "
+                                "remaining batches", name, remaining,
+                            )
+                            tech.execute(
+                                t, devices, tids[0],
+                                override_batch_count=remaining,
+                                **_execute_kwargs(tech, remaining,
+                                                  window_cap),
+                            )
+                            # the solo restore reset the cursor to the
+                            # detach point; advance only the solo portion
+                            t.reconfigure(remaining)
+                        else:
+                            t.reconfigure(steps)
+                        done = budget
+                    else:
+                        t.reconfigure(steps)
+                        if budget > steps:
+                            # lockstep ran to the SHORTEST member's budget;
+                            # give this member's shortfall back
+                            rollback_forecast(t, budget - steps)
+                        done = steps
+                    strat = t.selected_strategy
+                    if report.per_step_s > 0:
+                        old = strat.fused_per_batch_time
+                        strat.fused_per_batch_time = (
+                            report.per_step_s if old is None
+                            else 0.7 * report.per_step_s + 0.3 * old
+                        )
+                    if on_task_done is not None:
+                        on_task_done(name, done)
+                except BaseException as e:
+                    _record_error(name, e)
+                    if isinstance(e, PreemptedError):
+                        logger.warning("%s", e)
+                    else:
+                        logger.exception(
+                            "task %s failed during interval", name
+                        )
+        except BaseException as e:
+            for t in members:
+                # keep_first: a member that already recorded its own failure
+                # above keeps it; the group-level error only fills the gaps.
+                _record_error(t.name, e, keep_first=True)
+            if isinstance(e, PreemptedError):
+                logger.warning("%s", e)
+            else:
+                logger.exception("fused group %s failed", sorted(names))
+        finally:
+            for t in members:
+                events[t.name].set()
+
+    fused_groups = _fused_groups(run_tasks, plan)
+    fused_names = {t.name for g in fused_groups for t in g}
+    co_groups = _coschedule_groups(
+        [t for t in run_tasks if t.name not in fused_names], plan
+    )
+    grouped = {t.name for g in co_groups for t in g} | fused_names
     tid_of = {t.name: i for i, t in enumerate(run_tasks)}
 
     def _expected_s(t) -> float:
@@ -671,6 +844,21 @@ def execute(
             daemon=True,
             name="colaunch-" + "+".join(t.name for t in g),
         )
+        dl = (
+            guardian.window_deadline_s(sum(_expected_s(t) for t in g))
+            if use_watchdog else None
+        )
+        watch.append((th, [t.name for t in g], dl))
+    for g in fused_groups:
+        th = threading.Thread(
+            target=fused_launcher,
+            args=(g, [tid_of[t.name] for t in g]),
+            daemon=True,
+            name="fuselaunch-" + "+".join(t.name for t in g),
+        )
+        # Deadline covers the members' summed profiled solo work — a loose
+        # upper bound on the lockstep stack (the whole point of fusing is
+        # beating it), so the watchdog only fires on a genuine wedge.
         dl = (
             guardian.window_deadline_s(sum(_expected_s(t) for t in g))
             if use_watchdog else None

@@ -405,6 +405,50 @@ def fold_realized_feedback(run_tasks) -> dict:
     return updates
 
 
+def _fusion_proposals(tasks) -> Optional[List[List[str]]]:
+    """Candidate fusion groups for a solve call (same-fingerprint task
+    names, ``parallel/fused.fusion_candidates``). Proposing is free: only
+    groups whose members all carry a measured ``fused_per_batch_time`` can
+    win the pricing (``milp.fusion_priced_groups`` refuses guesswork), so
+    an unprofiled sweep degrades to exactly the pre-fusion plan. Fail open
+    on any trouble — fusion is an optimization, never a launch blocker."""
+    try:
+        from saturn_tpu.parallel import fused as _fused
+
+        return _fused.fusion_candidates(tasks) or None
+    except Exception:
+        logger.exception("fusion candidate proposal failed (fail-open)")
+        return None
+
+
+def _memlens_fusion_gate(topo):
+    """Adapt memlens' stacked-residency pass to the solver's
+    ``fusion_fits(member_tasks, size, n_members)`` contract: an explicit
+    False (the ×N stacked params would blow past the OOM margin) vetoes
+    that size before any compile; None (analyzer unavailable, capacity
+    unknown, untraceable config) never prunes — the zero-compile
+    feasibility-prior contract."""
+    def fits(member_tasks, size, n_members):
+        try:
+            from saturn_tpu.analysis.memlens import passes as ml_passes
+
+            rep = member_tasks[0]
+            strat = rep.feasible_strategies().get(size)
+            if strat is None or strat.executor is None:
+                return None
+            blocks = topo.blocks(size)
+            if not blocks:
+                return None
+            return ml_passes.fused_stack_fits(
+                strat.executor, rep, topo.block_devices(blocks[0]),
+                n_members, config=strat.params or None,
+            )
+        except Exception:
+            return None
+
+    return fits
+
+
 def _handle_topology_change(
     task_list, base_topo, health, replanner, change, plan, tlimit,
     all_failed,
@@ -498,6 +542,8 @@ def _orchestrate_loop(
             plan = anytime.anytime_resolve(
                 task_list, topo, None, interval, deadline=tlimit,
                 source="orchestrator-initial",
+                fusion=_fusion_proposals(task_list),
+                fusion_fits=_memlens_fusion_gate(topo),
             )
         else:
             plan = None
@@ -585,6 +631,12 @@ def _orchestrate_loop(
                             else None
                         ),
                         source="orchestrator",
+                        fusion=_fusion_proposals(remaining),
+                        fusion_exclude=(
+                            guardian.detached_names() if guardian is not None
+                            else None
+                        ),
+                        fusion_fits=_memlens_fusion_gate(topo),
                     )
 
                 # Snapshot the EXECUTED plan's assignments before the

@@ -4,4 +4,9 @@ The reference delegated all of this to external CUDA libraries (torchgpipe
 streams, fairscale offload, NCCL — SURVEY.md §2.2). Here the hot schedules are
 written against JAX primitives (``shard_map`` + ``ppermute`` + ``lax.scan``)
 and Pallas where a fused kernel beats XLA's default lowering.
+
+``stacking`` holds the pytree algebra for fused multi-model stacks
+(``parallel/fused.py``): stack/unstack member trees along a leading
+``model`` axis, slice one member out (checkpointing), and remove a
+diverged member mid-interval (the unfuse operation).
 """

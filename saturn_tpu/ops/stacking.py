@@ -1,0 +1,171 @@
+"""Pytree stacking for fused multi-model training (``parallel/fused.py``).
+
+N sweep members that share an architecture differ only in their leaf
+*values* (params, opt state, step counters) — never in tree structure or
+leaf shapes. Stacking prepends a ``model`` axis to every leaf so the whole
+group becomes ONE train state a single vmapped program advances; these
+helpers are the (un)stacking algebra the fused technique, its unfuse path
+and the per-member checkpoint slices are written against.
+
+All functions are pure tree_map wrappers: they work on host numpy trees
+(checkpoint assembly), device arrays (mid-interval unfuse slicing) and
+``ShapeDtypeStruct`` trees (shape/sharding templates) alike.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MemberShapeError(ValueError):
+    """A member's array disagrees with the group's common shape/dtype.
+
+    Raised at *staging* time with the offending member's task name, so a
+    heterogeneous group fails with an attributable message instead of an
+    opaque XLA shape-check deep inside the stacked program (ISSUE 16
+    satellite: the prefetcher's stacked-window contract)."""
+
+    def __init__(self, member: str, got: Any, want: Any, what: str = "batch"):
+        self.member = member
+        self.got = got
+        self.want = want
+        super().__init__(
+            f"fused member {member!r}: {what} shape/dtype {got} does not "
+            f"match the group's {want} — fusion requires identical "
+            f"per-member shapes (same batch_size/seq_len/model config)"
+        )
+
+
+def stack_trees(trees: Sequence[Any]) -> Any:
+    """Stack N structurally-identical trees along a new leading axis.
+
+    Leaf k of the result has shape ``(N, *leaf_k.shape)``. Host numpy in →
+    host numpy out (the checkpoint-assembly path stays off-device until the
+    single sharded ``device_put``); device arrays in → device out.
+    """
+    if not trees:
+        raise ValueError("stack_trees: empty member list")
+    first = trees[0]
+    for t in trees[1:]:
+        if jax.tree_util.tree_structure(t) != jax.tree_util.tree_structure(first):
+            raise ValueError(
+                "stack_trees: member trees have different structures — "
+                "fusion requires an identical ModelSpec fingerprint"
+            )
+    leaves = [jax.tree_util.tree_leaves(t) for t in trees]
+    out_leaves = []
+    for col in zip(*leaves):
+        if isinstance(col[0], (np.ndarray, np.generic)) or not hasattr(
+            col[0], "devices"
+        ):
+            out_leaves.append(np.stack([np.asarray(x) for x in col]))
+        else:
+            out_leaves.append(jnp.stack(col))
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(first), out_leaves
+    )
+
+
+def unstack_tree(tree: Any, n: int) -> List[Any]:
+    """Split a stacked tree back into its N member trees (inverse of
+    :func:`stack_trees`)."""
+    return [member_slice(tree, i) for i in range(int(n))]
+
+
+def member_slice(tree: Any, i: int) -> Any:
+    """Member ``i``'s tree: every leaf's ``[i]`` slice (leading-axis drop).
+
+    This is the per-member checkpoint view — the slice is what the sharded
+    manifest writer persists for one member, identical in shape/dtype to the
+    state the member's solo program would have produced.
+    """
+    return jax.tree_util.tree_map(lambda x: x[int(i)], tree)
+
+
+def remove_member(tree: Any, i: int) -> Any:
+    """A stacked tree with member ``i`` sliced OUT — the unfuse operation.
+
+    Every leaf ``(N, ...)`` becomes ``(N-1, ...)``; member order of the
+    survivors is preserved, so surviving index ``j`` maps to old index
+    ``j if j < i else j + 1``.
+    """
+    i = int(i)
+
+    def drop(x):
+        n = x.shape[0]
+        if not 0 <= i < n:
+            raise IndexError(f"remove_member: index {i} out of range for N={n}")
+        if isinstance(x, (np.ndarray, np.generic)):
+            return np.delete(x, i, axis=0)
+        return jnp.concatenate([x[:i], x[i + 1:]], axis=0)
+
+    return jax.tree_util.tree_map(drop, tree)
+
+
+def stacked_shapes(member_shapes: Any, n: int) -> Any:
+    """ShapeDtypeStruct tree for an N-stack of a member-shaped tree."""
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct((int(n), *s.shape), s.dtype),
+        member_shapes,
+    )
+
+
+def stack_member_batches(
+    batches: Sequence[Any],
+    member_names: Optional[Sequence[str]] = None,
+    expect: Optional[Tuple[int, ...]] = None,
+) -> np.ndarray:
+    """One ``(N, batch, seq)`` staging stack from N members' host batches.
+
+    The shape contract of the fused data path: every member's batch must
+    share shape AND dtype. A mismatch raises :class:`MemberShapeError`
+    naming the offending member's task id — the attributable error the
+    prefetcher's contract promises instead of an XLA stack failure.
+
+    ``expect``: the per-member ``(batch, seq)`` shape the compiled program
+    was staged for; when given, member 0 is validated against it too (a
+    group whose FIRST member drifted would otherwise pass self-consistency).
+    """
+    arrs = [np.asarray(b) for b in batches]
+    if not arrs:
+        raise ValueError("stack_member_batches: empty member list")
+    names = list(member_names) if member_names is not None else [
+        f"member[{i}]" for i in range(len(arrs))
+    ]
+    want = tuple(expect) if expect is not None else arrs[0].shape
+    want_dtype = arrs[0].dtype
+    for name, a in zip(names, arrs):
+        if tuple(a.shape) != tuple(want) or a.dtype != want_dtype:
+            raise MemberShapeError(
+                name, (tuple(a.shape), str(a.dtype)),
+                (tuple(want), str(want_dtype)),
+            )
+    return np.stack(arrs)
+
+
+def stacked_hparam_array(
+    values: Sequence[float], dtype: Any = np.float32
+) -> jnp.ndarray:
+    """Per-member hyperparameters as a stacked ``(N,)`` vector.
+
+    Passed into the vmapped step alongside the state stack, so each member's
+    optimizer update closes over ITS value as a traced scalar — bit-identical
+    to the solo program's concrete-float closure (verified by
+    ``tests/test_fused.py``'s trajectory-equivalence cases).
+    """
+    return jnp.asarray(np.asarray(list(values), dtype=dtype))
+
+
+def tree_equal(a: Any, b: Any) -> bool:
+    """Bitwise equality of two host trees (test/bench helper)."""
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        return False
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )

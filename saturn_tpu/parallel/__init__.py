@@ -52,3 +52,11 @@ try:
     BUILTIN_TECHNIQUES["ulysses"] = UlyssesSequenceParallel
 except ImportError:  # pragma: no cover
     pass
+
+# Fused multi-model stacking is NOT a registered technique — it wraps a
+# member technique's program across N compatible jobs (the solver prices it
+# per GROUP, not per task) — but its public surface rides along here.
+try:
+    from saturn_tpu.parallel import fused  # noqa: F401
+except ImportError:  # pragma: no cover
+    fused = None  # type: ignore[assignment]

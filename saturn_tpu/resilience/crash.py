@@ -51,7 +51,11 @@ logger = logging.getLogger("saturn_tpu")
 #: bytes staged, the shard rename not yet done — and ``pre-manifest-rename``
 #: — every shard durable, the manifest (the commit point) not yet renamed.
 #: A kill at either must leave the previously published generation fully
-#: restorable.
+#: restorable. ``fused.unfuse`` is the unfuse transition of a fused stack
+#: (``parallel/fused.run_fused_interval``): crossed AFTER a detaching
+#: member's state is sliced out of the stack but BEFORE its checkpoint
+#: lands — a kill here leaves nothing durable from the interval, so replay
+#: re-runs it bit-identically and unfuses at the same boundary exactly once.
 KILL_POINTS = (
     "pre-commit",
     "mid-fsync",
@@ -63,6 +67,7 @@ KILL_POINTS = (
     "post-rollback",
     "mid-shard-write",
     "pre-manifest-rename",
+    "fused.unfuse",
 )
 
 

@@ -145,18 +145,25 @@ class FrontierTimeline:
 
 def chain_dependencies(assignments: Dict[str, Assignment],
                        coschedule: Optional[List[List[str]]] = None,
+                       fused: Optional[List[List[str]]] = None,
                        ) -> Dict[str, List[str]]:
     """Sparse per-device chain edges: on every device, each occupant depends
     on the previous occupant (start order). Any two tasks whose blocks
     overlap share at least one device, so they are connected through that
     device's chain — the same race-freedom property the O(N²)
     ``Plan.compute_dependencies`` edge set guarantees, at O(total occupancy)
-    size. Members of one co-schedule group are exempt, as in the dense form.
+    size. Members of one co-schedule group are exempt, as in the dense form;
+    so are members of one FUSION group (they are one stacked program holding
+    identical assignments by construction).
     """
     group_of: Dict[str, int] = {}
     for gi, grp in enumerate(coschedule or []):
         for n in grp:
             group_of[n] = gi
+    fgroup_of: Dict[str, int] = {}
+    for gi, grp in enumerate(fused or []):
+        for n in grp:
+            fgroup_of[n] = gi
     per_device: Dict[int, List[Tuple[float, str]]] = {}
     for name, a in assignments.items():
         for d in range(a.block.offset, a.block.end):
@@ -168,19 +175,24 @@ def chain_dependencies(assignments: Dict[str, Assignment],
             g1, g2 = group_of.get(n1), group_of.get(n2)
             if g1 is not None and g1 == g2:
                 continue
+            f1, f2 = fgroup_of.get(n1), fgroup_of.get(n2)
+            if f1 is not None and f1 == f2:
+                continue
             deps[n2].add(n1)
     return {name: sorted(d) for name, d in deps.items()}
 
 
 def _finish_plan(assignments: Dict[str, Assignment],
-                 coschedule: Optional[List[List[str]]] = None) -> Plan:
+                 coschedule: Optional[List[List[str]]] = None,
+                 fused: Optional[List[List[str]]] = None) -> Plan:
     """Wrap assignments in a Plan with scale-appropriate dependencies."""
     makespan = max((a.start + a.runtime for a in assignments.values()),
                    default=0.0)
     plan = Plan(assignments=assignments, makespan=makespan,
-                coschedule=list(coschedule or []))
+                coschedule=list(coschedule or []), fused=list(fused or []))
     if len(assignments) > _CHAIN_DEP_N:
-        plan.dependencies = chain_dependencies(assignments, plan.coschedule)
+        plan.dependencies = chain_dependencies(assignments, plan.coschedule,
+                                               plan.fused)
     else:
         plan.compute_dependencies()
     return plan
@@ -418,7 +430,9 @@ def partition_plan(task_list: Sequence, topology: SliceTopology,
                    budget: float, ordering_slack: float = 1.0,
                    weights: Optional[Dict[str, float]] = None,
                    previous: Optional[Plan] = None,
-                   coschedule_exclude=None) -> Optional[Plan]:
+                   coschedule_exclude=None,
+                   fusion: Optional[List[List[str]]] = None,
+                   fusion_exclude=None, fusion_fits=None) -> Optional[Plan]:
     """Tier 1: solve each partition's MILP under its time slice, then stitch.
 
     The merge keeps each task's partition-chosen apportionment (the
@@ -426,9 +440,10 @@ def partition_plan(task_list: Sequence, topology: SliceTopology,
     re-places every task on the frontier in global start order, choosing the
     min-finish block of the chosen size — always feasible, conflict-free by
     construction. A single partition returns the exact plan untouched
-    (co-schedule groups included); multi-partition stitches are
-    conservatively serial, so co-location proposals only appear at exact
-    scale.
+    (co-schedule AND fusion groups included); multi-partition stitches are
+    conservatively serial, so co-location and fusion proposals only appear
+    at exact scale (the merge's re-placement cannot honor a group's shared
+    assignment).
     """
     t0 = time.perf_counter()
     parts = _partitions(task_list, previous, partition_max())
@@ -436,7 +451,9 @@ def partition_plan(task_list: Sequence, topology: SliceTopology,
         return milp.solve(task_list, topology,
                           time_limit=max(0.05, budget * 0.9),
                           ordering_slack=ordering_slack, weights=weights,
-                          warm=previous, coschedule_exclude=coschedule_exclude)
+                          warm=previous, coschedule_exclude=coschedule_exclude,
+                          fusion=fusion, fusion_exclude=fusion_exclude,
+                          fusion_fits=fusion_fits)
 
     slice_budget = max(_MIN_PART_SLICE, (budget * 0.8) / len(parts))
     placed: List[Tuple[float, int, Any, int, float]] = []  # (start, pi, task, size, rt)
@@ -628,6 +645,8 @@ def anytime_solve(task_list: Sequence, topology: SliceTopology,
                   ordering_slack: float = 1.0,
                   weights: Optional[Dict[str, float]] = None,
                   coschedule_exclude=None, seed: int = 0,
+                  fusion: Optional[List[List[str]]] = None,
+                  fusion_exclude=None, fusion_fits=None,
                   ) -> Tuple[Plan, AnytimeReport]:
     """Race down the tier ladder; always returns a plan within ~``deadline``.
 
@@ -674,7 +693,9 @@ def anytime_solve(task_list: Sequence, topology: SliceTopology,
             tried.append(1)
             p1 = partition_plan(task_list, topology, budget, ordering_slack,
                                 weights, previous=previous,
-                                coschedule_exclude=coschedule_exclude)
+                                coschedule_exclude=coschedule_exclude,
+                                fusion=fusion, fusion_exclude=fusion_exclude,
+                                fusion_fits=fusion_fits)
             if p1 is not None and (best is None or p1.makespan < best.makespan):
                 best, best_tier = p1, 1
         elif best is None and remaining() - floor_est >= _est_lp(n):
@@ -760,7 +781,9 @@ def anytime_resolve(task_list: Sequence, topology: SliceTopology,
                     coschedule_exclude=None,
                     warm: Optional[Plan] = None,
                     ordering_slack: float = 1.0,
-                    source: str = "resolve", seed: int = 0) -> Plan:
+                    source: str = "resolve", seed: int = 0,
+                    fusion: Optional[List[List[str]]] = None,
+                    fusion_exclude=None, fusion_fits=None) -> Plan:
     """Deadline-bounded drop-in for ``milp.resolve``: tier-ladder fresh
     solve + the introspective compare-and-swap, one ``solver_tier`` metrics
     event per call.
@@ -776,6 +799,8 @@ def anytime_resolve(task_list: Sequence, topology: SliceTopology,
         task_list, topology, dl, previous=warm_seed,
         ordering_slack=ordering_slack, weights=weights,
         coschedule_exclude=coschedule_exclude, seed=seed,
+        fusion=fusion, fusion_exclude=fusion_exclude,
+        fusion_fits=fusion_fits,
     )
     if previous is None:
         _emit_tier_event(report, source)
@@ -797,15 +822,26 @@ def anytime_resolve(task_list: Sequence, topology: SliceTopology,
                 kept for grp in previous.coschedule
                 if len(kept := [n for n in grp if n in cur_names]) >= 2
             ],
+            # surviving fusion groups slide like co-schedule groups; a stack
+            # shrunk below 2 members stops being a stack
+            fused=[
+                kept for grp in previous.fused
+                if len(kept := [n for n in grp if n in cur_names]) >= 2
+            ],
         )
         if coschedule_exclude:
             excl = set(coschedule_exclude)
             if any(excl & set(grp) for grp in slid.coschedule):
                 adopt_fresh = True  # a detached member sits in a slid group
+        if fusion_exclude:
+            excl = set(fusion_exclude)
+            if any(excl & set(grp) for grp in slid.fused):
+                adopt_fresh = True  # a quarantined member sits in a slid stack
         if not adopt_fresh:
             if len(slid.assignments) > _CHAIN_DEP_N:
                 slid.dependencies = chain_dependencies(slid.assignments,
-                                                       slid.coschedule)
+                                                       slid.coschedule,
+                                                       slid.fused)
             else:
                 slid.compute_dependencies()
             adopt_fresh = fresh.makespan < slid.makespan - threshold

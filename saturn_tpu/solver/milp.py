@@ -59,6 +59,16 @@ class Plan:
     # one job's host phases can hide under the other's device windows; empty
     # everywhere else (warm/greedy/native plans are conservatively serial).
     coschedule: List[List[str]] = field(default_factory=list)
+    # Fusion groups: lists of task names the engine trains as ONE stacked
+    # SPMD program (``parallel/fused.py``) — N identical-architecture sweep
+    # members advancing in lockstep under a single compiled step. Members of
+    # a group hold IDENTICAL assignments (same block, same start, runtime =
+    # the fused lockstep runtime) by construction; like co-schedule groups
+    # their mutual overlap is the point, not a race. Produced only by the
+    # fusion pricing pre-pass in :func:`solve` when every member carries a
+    # measured ``fused_per_batch_time`` and the fused runtime beats both the
+    # serial and the co-scheduled alternative.
+    fused: List[List[str]] = field(default_factory=list)
 
     def coschedule_group_of(self) -> Dict[str, int]:
         """task name -> index of its co-schedule group (absent = solo)."""
@@ -68,19 +78,33 @@ class Plan:
                 out[n] = gi
         return out
 
+    def fused_group_of(self) -> Dict[str, int]:
+        """task name -> index of its fusion group (absent = not fused)."""
+        out: Dict[str, int] = {}
+        for gi, grp in enumerate(self.fused):
+            for n in grp:
+                out[n] = gi
+        return out
+
     def compute_dependencies(self) -> None:
         """Edges between tasks whose blocks overlap: later start depends on
         earlier (reference builds deps from GPU-overlap ∩ boa,
         ``milp.py:489-511``). Members of one co-schedule group are exempt:
         their overlap is the point — the engine interleaves them on a shared
-        launcher rather than ordering them."""
+        launcher rather than ordering them. Members of one FUSION group are
+        exempt for the stronger reason: they are one program, and their
+        assignments are identical by construction."""
         group_of = self.coschedule_group_of()
+        fgroup_of = self.fused_group_of()
         deps: Dict[str, List[str]] = {name: [] for name in self.assignments}
         items = list(self.assignments.items())
         for i, (n1, a1) in enumerate(items):
             for n2, a2 in items[i + 1 :]:
                 g1, g2 = group_of.get(n1), group_of.get(n2)
                 if g1 is not None and g1 == g2:
+                    continue
+                f1, f2 = fgroup_of.get(n1), fgroup_of.get(n2)
+                if f1 is not None and f1 == f2:
                     continue
                 if a1.block.overlaps(a2.block):
                     if a1.start <= a2.start:
@@ -129,6 +153,7 @@ class Plan:
             },
             "dependencies": self.dependencies,
             "coschedule": [list(g) for g in self.coschedule],
+            "fused": [list(g) for g in self.fused],
         }
 
     @staticmethod
@@ -143,6 +168,8 @@ class Plan:
             dependencies={k: list(v) for k, v in d["dependencies"].items()},
             # absent in plans journaled before the co-schedule term existed
             coschedule=[list(g) for g in d.get("coschedule", [])],
+            # absent in plans journaled before fused stacking existed
+            fused=[list(g) for g in d.get("fused", [])],
         )
 
 
@@ -360,6 +387,136 @@ def coschedule_candidates(
     return out
 
 
+class _FusedPseudoTask:
+    """Stand-in the MILP schedules in place of a whole fusion group.
+
+    Carries ONLY the fused option set (the sizes the group was actually
+    priced at), so no solver path — exact, native, warm, greedy — can place
+    the group at a size its fused program was never profiled for. Its
+    strategies report zero host/bubble fractions, which keeps it out of the
+    co-location candidate generator (a fused stack is already the denser
+    packing; interleaving it with a third job is the engine's problem, not
+    the solver's).
+    """
+
+    def __init__(self, name: str, strategies: Dict[int, Any]):
+        self.name = name
+        self.strategies = strategies
+
+    def feasible_strategies(self) -> Dict[int, Any]:
+        return self.strategies
+
+
+def _remaining_batches(strat) -> Optional[float]:
+    """Remaining batch count implied by a strategy's (runtime, per-batch)
+    estimates; None when per-batch time was never measured — fusion pricing
+    refuses to guess."""
+    pbt = float(getattr(strat, "per_batch_time", 0.0) or 0.0)
+    if pbt <= 0.0:
+        return None
+    return max(0.0, float(strat.runtime) / pbt)
+
+
+def fusion_priced_groups(
+    task_list: List,
+    proposed: List[List[str]],
+    topology: SliceTopology,
+    fusion_exclude=None,
+    fusion_fits=None,
+) -> List[Tuple[List[str], int, float, float]]:
+    """Price each proposed fusion group on MEASURED cost; keep the winners.
+
+    For each candidate group (same ModelSpec fingerprint, from
+    ``parallel/fused.fusion_candidates``) and each sub-mesh size at which
+    EVERY member holds a feasible strategy with a measured
+    ``fused_per_batch_time``, the fused stack occupies the block for
+
+        ``fused_rt = max_m(remaining_batches_m) * max_m(fused_per_batch_time_m)``
+
+    — lockstep: the stack runs until its longest member finishes (shorter
+    members detach early, but the block is booked for the stack). The group
+    fuses only when that beats BOTH alternatives the solver could otherwise
+    pick on the same size:
+
+    - serial: ``sum_m(runtime_m)`` — members run back-to-back;
+    - co-scheduled pairs: members paired longest-with-longest, each pair
+      priced at the interleaved combined occupancy from
+      :func:`coschedule_candidates`'s formula, pairs serialized.
+
+    ``fusion_exclude`` drops individual members (the health guardian's
+    quarantined repeat offenders) — the rest of the group can still fuse if
+    >= 2 members remain. ``fusion_fits`` is the memlens residency gate:
+    ``(member_tasks, size, n_members) -> Optional[bool]``; an explicit False
+    (the x N stacked HBM residency exceeds capacity) vetoes the size, None
+    (unknown) does not prune — exactly the analyzer's zero-compile
+    feasibility-prior contract.
+
+    Returns ``[(member_names, size, fused_runtime, fused_per_batch_time)]``
+    with each group priced at its best (smallest fused runtime) size.
+    """
+    by_name = {t.name: t for t in task_list}
+    excl = set(fusion_exclude or ())
+    out: List[Tuple[List[str], int, float, float]] = []
+    claimed: set = set()
+    for group in proposed:
+        names = [n for n in group if n in by_name and n not in excl
+                 and n not in claimed]
+        if len(names) < 2:
+            continue
+        members = [by_name[n] for n in names]
+        common = None
+        for m in members:
+            sizes = {
+                s for s, strat in m.feasible_strategies().items()
+                if s <= topology.capacity
+                and getattr(strat, "fused_per_batch_time", None) is not None
+            }
+            common = sizes if common is None else (common & sizes)
+        best: Optional[Tuple[float, int, float]] = None  # (fused_rt, size, fpbt)
+        for size in sorted(common or ()):
+            strats = [m.feasible_strategies()[size] for m in members]
+            batches = [_remaining_batches(s) for s in strats]
+            if any(b is None for b in batches):
+                continue  # a member's per-batch time was never measured
+            fpbt = max(float(s.fused_per_batch_time) for s in strats)
+            fused_rt = max(batches) * fpbt
+            serial = sum(float(s.runtime) for s in strats)
+            # Co-scheduled alternative: longest-with-longest pairs, each at
+            # the interleaved combined occupancy, pairs serialized on the
+            # block (the engine runs one shared launcher at a time).
+            ordered = sorted(
+                zip(members, strats), key=lambda p: -float(p[1].runtime)
+            )
+            cosched = 0.0
+            i = 0
+            while i < len(ordered):
+                if i + 1 < len(ordered):
+                    (t1, s1), (t2, s2) = ordered[i], ordered[i + 1]
+                    f1 = _fillable_fraction_of(t1, size)
+                    f2 = _fillable_fraction_of(t2, size)
+                    rt1, rt2 = float(s1.runtime), float(s2.runtime)
+                    cosched += max(
+                        rt1, rt2, (1.0 - f1) * rt1 + (1.0 - f2) * rt2
+                    )
+                    i += 2
+                else:
+                    cosched += float(ordered[i][1].runtime)
+                    i += 1
+            if fused_rt >= min(serial, cosched):
+                continue  # measured cost does not favor fusion at this size
+            if fusion_fits is not None and fusion_fits(
+                members, size, len(members)
+            ) is False:
+                continue  # memlens: stacked residency would not fit
+            if best is None or fused_rt < best[0]:
+                best = (fused_rt, size, fpbt)
+        if best is not None:
+            fused_rt, size, fpbt = best
+            out.append((names, size, fused_rt, fpbt))
+            claimed.update(names)
+    return out
+
+
 def solve(
     task_list: List,
     topology: SliceTopology,
@@ -370,6 +527,9 @@ def solve(
     weights: Optional[Dict[str, float]] = None,
     coschedule_min_gain: float = 1.15,
     coschedule_exclude=None,
+    fusion: Optional[List[List[str]]] = None,
+    fusion_exclude=None,
+    fusion_fits=None,
 ) -> Plan:
     """Build and solve the joint strategy/placement/schedule MILP.
 
@@ -408,6 +568,17 @@ def solve(
     CANDIDATE level — pairs touching an excluded name never get a ``co``
     binary — because group members hold overlapping assignments: stripping
     a member from an already-solved group would be a device race.
+
+    ``fusion``: proposed fusion groups (lists of task names sharing a
+    ModelSpec fingerprint, from ``parallel/fused.fusion_candidates``). Each
+    group is priced on measured cost by :func:`fusion_priced_groups`; the
+    winners are collapsed to one :class:`_FusedPseudoTask` each, the reduced
+    batch is solved normally (every path — exact MILP, native, warm, greedy
+    — sees the pseudo-task), and the decoded plan is expanded so every
+    member holds the representative's assignment and ``Plan.fused`` records
+    the groups. ``fusion_exclude`` bars individual members (quarantined
+    repeat offenders); ``fusion_fits`` is the memlens stacked-residency gate
+    — see :func:`fusion_priced_groups`.
     """
     for t in task_list:
         if not t.feasible_strategies():
@@ -416,6 +587,95 @@ def solve(
             raise ValueError(
                 f"task {t.name}: no strategy fits topology capacity {topology.capacity}"
             )
+
+    if fusion:
+        winners = fusion_priced_groups(
+            task_list, fusion, topology,
+            fusion_exclude=fusion_exclude, fusion_fits=fusion_fits,
+        )
+        if winners:
+            from saturn_tpu.core.strategy import Strategy as _Strategy
+
+            by_name = {t.name: t for t in task_list}
+            fused_member: Dict[str, int] = {}  # member name -> winner index
+            reduced: List = []
+            red_weights = dict(weights) if weights else {}
+            for wi, (names, _, _, _) in enumerate(winners):
+                for n in names:
+                    fused_member[n] = wi
+            for wi, (names, _, _, _) in enumerate(winners):
+                rep = names[0]
+                # Pseudo-option set: every size the group was priced at
+                # (fusion_priced_groups returns only the best size, so
+                # re-derive the full priced set to keep the solver's choice).
+                strategies: Dict[int, Any] = {}
+                common = None
+                for n in names:
+                    sizes = {
+                        s for s, st in by_name[n].feasible_strategies().items()
+                        if s <= topology.capacity
+                        and getattr(st, "fused_per_batch_time", None) is not None
+                        and _remaining_batches(st) is not None
+                    }
+                    common = sizes if common is None else (common & sizes)
+                for size in sorted(common or ()):
+                    strats = [
+                        by_name[n].feasible_strategies()[size] for n in names
+                    ]
+                    fpbt = max(
+                        float(s.fused_per_batch_time) for s in strats
+                    )
+                    fused_rt = (
+                        max(_remaining_batches(s) for s in strats) * fpbt
+                    )
+                    if fusion_fits is not None and fusion_fits(
+                        [by_name[n] for n in names], size, len(names)
+                    ) is False:
+                        continue
+                    strategies[size] = _Strategy(
+                        executor=strats[0].executor,
+                        apportionment=size,
+                        params=dict(strats[0].params or {}),
+                        runtime=fused_rt,
+                        per_batch_time=fpbt,
+                    )
+                reduced.append(_FusedPseudoTask(rep, strategies))
+                if weights:
+                    red_weights[rep] = max(
+                        (weights.get(n, 0.0) for n in names), default=0.0
+                    )
+            reduced.extend(t for t in task_list if t.name not in fused_member)
+            inner = solve(
+                reduced, topology, time_limit=time_limit,
+                ordering_slack=ordering_slack,
+                milp_task_limit=milp_task_limit, warm=warm,
+                weights=red_weights or None,
+                coschedule_min_gain=coschedule_min_gain,
+                coschedule_exclude=coschedule_exclude,
+            )
+            assignments = dict(inner.assignments)
+            for names, _, _, _ in winners:
+                rep_a = assignments[names[0]]
+                for n in names[1:]:
+                    assignments[n] = Assignment(
+                        rep_a.apportionment, rep_a.block, rep_a.start,
+                        rep_a.runtime,
+                    )
+            plan = Plan(
+                assignments=assignments, makespan=inner.makespan,
+                coschedule=inner.coschedule,
+                fused=[list(names) for names, _, _, _ in winners],
+            )
+            plan.compute_dependencies()
+            log.info(
+                "fusion pre-pass: %d group(s) priced in favor of stacking "
+                "(%s)", len(winners),
+                "; ".join(
+                    f"{len(names)}@{size} fused={rt:.1f}s"
+                    for names, size, rt, _ in winners
+                ),
+            )
+            return plan
 
     wplan = (
         warm_schedule(task_list, topology, warm, ordering_slack,
@@ -825,6 +1085,9 @@ def resolve(
     warm_budget_frac: float = 0.25,
     weights: Optional[Dict[str, float]] = None,
     coschedule_exclude=None,
+    fusion: Optional[List[List[str]]] = None,
+    fusion_exclude=None,
+    fusion_fits=None,
 ) -> Plan:
     """Introspective re-solve with compare-and-swap (``milp.py:354-444``).
 
@@ -852,7 +1115,9 @@ def resolve(
         if warm_schedule(task_list, topology, previous) is not None:
             tl = max(1.0, time_limit * warm_budget_frac)
     fresh = solve(task_list, topology, time_limit=tl, warm=previous,
-                  weights=weights, coschedule_exclude=coschedule_exclude)
+                  weights=weights, coschedule_exclude=coschedule_exclude,
+                  fusion=fusion, fusion_exclude=fusion_exclude,
+                  fusion_fits=fusion_fits)
     if previous is None:
         return fresh
 
@@ -882,6 +1147,13 @@ def resolve(
             for grp in previous.coschedule
             if len(kept := [n for n in grp if n in cur_names]) >= 2
         ],
+        # surviving fusion groups slide too: a stack whose member finished
+        # (or was unfused) shrinks; below 2 members it stops being a stack
+        fused=[
+            kept
+            for grp in previous.fused
+            if len(kept := [n for n in grp if n in cur_names]) >= 2
+        ],
     )
     if coschedule_exclude:
         # A freshly detached member may still sit in the slid plan's groups
@@ -890,6 +1162,13 @@ def resolve(
         # excluded pairs, is the only valid choice.
         excl = set(coschedule_exclude)
         if any(excl & set(grp) for grp in slid.coschedule):
+            return fresh
+    if fusion_exclude:
+        # Same rule for a freshly quarantined fusion member: its groupmates
+        # hold the stack's shared assignment, so the slid plan cannot simply
+        # strip it — only the fresh solve (priced without it) is valid.
+        excl = set(fusion_exclude)
+        if any(excl & set(grp) for grp in slid.fused):
             return fresh
     slid.compute_dependencies()
     if fresh.makespan < slid.makespan - threshold:

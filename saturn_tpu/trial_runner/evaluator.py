@@ -621,10 +621,173 @@ def _search_inner(
             if g not in task.strategies:
                 task.strategies[g] = Strategy(None, g, None, DUMMY_RUNTIME)
 
+    # Fused-stacking trials: propose same-fingerprint groups and measure
+    # the stacked per-step cost so the solver can price fusion against the
+    # solo/co-scheduled grid (``milp.fusion_priced_groups`` refuses groups
+    # without a measured ``fused_per_batch_time``). Fail open per group — a
+    # group that cannot build or trace keeps ``fused_per_batch_time=None``
+    # and is simply never fused.
+    fused_groups = 0
+    try:
+        from saturn_tpu.parallel import fused as _fused
+
+        fusion_names = _fused.fusion_candidates(list(tasks))
+    except Exception:
+        fusion_names = []
+    if fusion_names:
+        by_name = {t.name: t for t in tasks}
+        for group_names in fusion_names:
+            group = [by_name[n] for n in group_names if n in by_name]
+            if len(group) < 2:
+                continue
+            try:
+                # metrics_path=None: the caller (``search``) already scoped
+                # the ambient writer, so trial_fused events land there.
+                measured = profile_fused_group(group, topology=topo)
+            except Exception:
+                logger.exception(
+                    "fused trial for group %s failed (fail-open)",
+                    group_names,
+                )
+                continue
+            if any(v > 0 for v in measured.values()):
+                fused_groups += 1
+        if fused_groups:
+            logger.info(
+                "trial runner: %d fused group(s) measured", fused_groups
+            )
+
     return {
         "trials_run": eta.completed,
         "cache_hits": n_hits,
         "pruned": eta.pruned,
         "interpolated": n_interp,
         "dispatch": dispatch,
+        "fused_groups": fused_groups,
     }
+
+
+def profile_fused_group(
+    tasks: Sequence,
+    sizes: Optional[Sequence[int]] = None,
+    topology: Optional[SliceTopology] = None,
+    steps: int = 3,
+    warmup: int = 1,
+    metrics_path: Optional[str] = None,
+) -> Dict[int, float]:
+    """Profile the FUSED stack of ``tasks`` and price its lockstep step.
+
+    The fused-stacking analog of the per-job grid sweep: builds the stacked
+    program for the group at each candidate sub-mesh size, times a few
+    lockstep steps on freshly-initialized member states, and writes the
+    measured seconds-per-lockstep-step into every member's
+    ``Strategy.fused_per_batch_time`` at that size. The solver fuses strictly
+    on these measurements (``solver/milp.fusion_priced_groups``) — a size
+    this function never priced keeps ``fused_per_batch_time=None`` and is
+    never fused on guesswork.
+
+    Pure measurement: unlike ``parallel.fused.run_fused_interval`` this
+    neither checkpoints nor advances any task's cursor — member states are
+    init-from-scratch throwaways and batches are read (not consumed) via
+    ``batch_at(0)``.
+
+    ``sizes=None`` profiles every size at which ALL members already hold a
+    feasible (searched) strategy — run :func:`search` first. Returns
+    ``{size: measured_per_lockstep_step_seconds}``.
+    """
+    import jax
+    import numpy as np
+
+    from saturn_tpu.core import distributed as _dist
+    from saturn_tpu.ops import stacking
+    from saturn_tpu.parallel import fused as _fused
+
+    members = list(tasks)
+    if len(members) < 2:
+        raise ValueError("a fused group needs at least 2 members")
+    fps = {_fused.fusion_fingerprint(t) for t in members}
+    if len(fps) != 1 or None in fps:
+        raise ValueError(
+            "tasks are not fusable: fusion fingerprints differ or are None "
+            f"({[t.name for t in members]})"
+        )
+
+    topo = topology or SliceTopology()
+    if sizes is None:
+        candidates = [
+            g for g in topo.valid_sizes()
+            if all(
+                g in t.strategies and t.strategies[g].feasible
+                for t in members
+            )
+        ]
+    else:
+        valid = set(topo.valid_sizes())
+        candidates = [int(g) for g in sizes if int(g) in valid]
+
+    measured: Dict[int, float] = {}
+    with metrics.scoped(metrics_path):
+        for g in candidates:
+            block = topo.blocks(g)[0]
+            devs = _fused.usable_devices(
+                block.devices_of(topo.devices), len(members)
+            )
+            try:
+                prog = _fused.build_fused_program(members, devs)
+                state = _dist.put_tree_global(
+                    stacking.stack_trees(
+                        [prog.init_member_host(m.hparams.lr) for m in members]
+                    ),
+                    prog.state_shardings,
+                )
+                lrs_dev = _dist.put_global(
+                    np.asarray(
+                        [m.hparams.lr for m in members], dtype=np.float32
+                    ),
+                    prog.lr_sharding,
+                )
+                batch_dev = _dist.put_global(
+                    stacking.stack_member_batches(
+                        [m.batch_at(0) for m in members],
+                        member_names=[m.name for m in members],
+                    ),
+                    prog.batch_sharding,
+                )
+                fn = prog.single_compiled()
+                for _ in range(max(int(warmup), 0)):
+                    state, loss = fn(state, batch_dev, lrs_dev)
+                jax.block_until_ready(state)
+                n = max(int(steps), 1)
+                t0 = timeit.default_timer()
+                for _ in range(n):
+                    state, loss = fn(state, batch_dev, lrs_dev)
+                jax.block_until_ready((state, loss))
+                per_step = (timeit.default_timer() - t0) / n
+            except Exception as e:
+                # A size the stacked program cannot run (e.g. XLA memory
+                # rejection of the N-way stack) is a result, not a flake:
+                # fused_per_batch_time stays None and the solver never
+                # fuses at this size.
+                logger.info(
+                    "fused trial (%s, g=%d): infeasible (%r)",
+                    "+".join(t.name for t in members), g, e,
+                )
+                metrics.event(
+                    "trial_fused", tasks=[t.name for t in members], size=g,
+                    n_members=len(members), feasible=False, error=repr(e),
+                )
+                continue
+            for m in members:
+                strat = m.strategies.get(g)
+                if strat is not None and strat.feasible:
+                    strat.fused_per_batch_time = per_step
+            measured[g] = per_step
+            logger.info(
+                "fused trial (%s, g=%d, N=%d): %.4fs/lockstep step",
+                "+".join(t.name for t in members), g, len(members), per_step,
+            )
+            metrics.event(
+                "trial_fused", tasks=[t.name for t in members], size=g,
+                n_members=len(members), feasible=True, per_step_s=per_step,
+            )
+    return measured

@@ -93,6 +93,17 @@ def cache_dir() -> str:
     return os.path.join(_pc.default_dir(), _SUBDIR)
 
 
+def _fusion_version() -> int:
+    """Fused-stacking machinery version (lazy: utils must not import
+    parallel at module level; 0 = fusion unavailable)."""
+    try:
+        from saturn_tpu.parallel.fused import FUSION_SET_VERSION
+
+        return int(FUSION_SET_VERSION)
+    except Exception:
+        return 0
+
+
 def _runtime_identity() -> str:
     """Everything about the process that makes a serialized executable
     loadable: a hit compiled under a different jax, backend, device set or
@@ -119,6 +130,10 @@ def _runtime_identity() -> str:
             # gate what lowers at all, so executables cached under one
             # liveness model must miss under another
             f"memlens{_MEMLENS_PASS}",
+            # fused-stacking version: the stacked step's HLO depends on the
+            # fusion machinery, so executables cached under one stacked
+            # program must miss when FUSION_SET_VERSION bumps
+            f"fusion{_fusion_version()}",
             f"jax:{jax.__version__}",
             f"backend:{jax.default_backend()}",
             f"machine:{platform.machine()}",

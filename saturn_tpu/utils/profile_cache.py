@@ -189,6 +189,24 @@ def schedule_signature() -> str:
         return "gpipe-only"
 
 
+def fusion_signature() -> str:
+    """Version of the fused-stacking machinery — part of every fingerprint.
+
+    A profile's ``fused_per_batch_time`` (and the solver decisions priced on
+    it) describes the stacked program of a specific fusion version; when the
+    stacked step's semantics change (``parallel/fused.FUSION_SET_VERSION``)
+    stale entries must MISS so groups re-trial instead of fusing on a
+    measurement of a program that no longer exists. Lazy import like
+    ``schedule_signature``: utils must not import parallel at module level.
+    """
+    try:
+        from saturn_tpu.parallel.fused import fusion_signature as _fs
+
+        return _fs()
+    except Exception:
+        return "no-fusion"
+
+
 def fingerprint(
     task_sig: str, technique: str, size: int, topo_sig: str,
     dispatch: Optional[str] = None,
@@ -229,6 +247,9 @@ def fingerprint(
             # before 1F1B landed must miss — its cached params lack the
             # schedule key and its timing raced a narrower grid.
             "schedules": schedule_signature(),
+            # Fusion-set version: entries recorded before cross-job stacking
+            # existed (or under a different stacked-step program) must miss.
+            "fusion": fusion_signature(),
         },
         sort_keys=True,
     )

@@ -1,0 +1,545 @@
+"""Fused multi-model stacking (round 21): the stacked-program runtime, the
+solver's measured-cost fusion pricing, the unfuse transition, and the
+supporting surfaces (stacking algebra, prefetcher shape contract, plan
+verifier diagnostics, memlens residency gate, fused trial profiling).
+
+The tentpole claim mirrors rounds 10/11's trajectory-equivalence bar:
+training N compatible sweep jobs as ONE compiled SPMD program (params and
+optimizer state stacked along a leading ``model`` axis, the step vmapped
+over it, per-member LR as a stacked array) is a pure dispatch-packing
+change — every member's loss/checkpoint trajectory is bit-identical to its
+solo run, through unfuse-and-resume and through a kill inside the unfuse
+transition.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from saturn_tpu import HParams, Task
+from saturn_tpu.core.mesh import Block, SliceTopology
+from saturn_tpu.core.strategy import Strategy
+from saturn_tpu.data.lm_dataset import make_lm_dataset
+from saturn_tpu.models.gpt2 import build_gpt2
+from saturn_tpu.models.loss import pretraining_loss
+from saturn_tpu.ops import stacking
+from saturn_tpu.parallel import fused
+from saturn_tpu.parallel.dp import DataParallel
+from saturn_tpu.solver import milp
+from saturn_tpu.solver.milp import Assignment, Plan
+from saturn_tpu.utils import checkpoint as ckpt
+
+pytestmark = pytest.mark.fused
+
+SEQ = 16
+BATCH = 2
+VOCAB = 64
+N_BATCHES = 6
+SWEEP_LRS = {"a": 1e-3, "b": 2e-3, "c": 5e-4}
+
+
+# --------------------------------------------------------------- fakes
+class FakeDev:
+    platform = "cpu"
+    device_kind = "fake-cpu"
+    process_index = 0
+
+
+def fake_topo(n=8):
+    return SliceTopology([FakeDev() for _ in range(n)])
+
+
+class FakeTask:
+    """Solver-facing task: name + per-size strategy table."""
+
+    def __init__(self, name, sizes, runtime=10.0, pbt=0.1, fused_pbt=None):
+        self.name = name
+        self.strategies = {
+            g: Strategy(object(), g, {}, runtime, pbt,
+                        fused_per_batch_time=fused_pbt)
+            for g in sizes
+        }
+
+    def feasible_strategies(self):
+        return {g: s for g, s in self.strategies.items() if s.feasible}
+
+
+# --------------------------------------------------------------- real tasks
+def make_member(save_dir: str, name: str, lr: float,
+                batch_count: int = N_BATCHES) -> Task:
+    t = Task(
+        get_model=lambda **kw: build_gpt2("test-tiny", seq_len=SEQ, **kw),
+        get_dataloader=lambda: make_lm_dataset(
+            context_length=SEQ, batch_size=BATCH, vocab_size=VOCAB,
+            n_tokens=SEQ * BATCH * 16,
+        ),
+        loss_fn=pretraining_loss,
+        hparams=HParams(lr=lr, batch_count=batch_count),
+        chip_range=[1],
+        name=name,
+        save_dir=save_dir,
+    )
+    t.strategies[1] = Strategy(executor=DataParallel(), apportionment=1,
+                               params={}, runtime=1.0, per_batch_time=0.01)
+    t.select_strategy(1)
+    return t
+
+
+@pytest.fixture(scope="module")
+def solo_refs(tmp_path_factory):
+    """Uninterrupted solo runs of the sweep configs — the bit-identity
+    reference every fused/unfused trajectory must reproduce."""
+    root = tmp_path_factory.mktemp("solo_refs")
+    tech = DataParallel()
+    devs = jax.devices()[:1]
+    states = {}
+    for key, lr in SWEEP_LRS.items():
+        t = make_member(str(root / key), f"solo-{key}", lr)
+        tech.execute(t, devs, 0, override_batch_count=N_BATCHES)
+        ckpt.flush()
+        states[key] = ckpt.load_arrays(t.ckpt_path)
+    return states
+
+
+def assert_states_equal(got: dict, want: dict, who: str) -> None:
+    assert set(got) == set(want), who
+    for k in sorted(want):
+        assert np.array_equal(got[k], want[k]), f"{who}: leaf {k} diverged"
+
+
+# =================================================================== stacking
+class TestStacking:
+    def _tree(self, seed):
+        rng = np.random.default_rng(seed)
+        return {"w": rng.normal(size=(3, 4)).astype(np.float32),
+                "b": rng.normal(size=(4,)).astype(np.float32)}
+
+    def test_stack_unstack_roundtrip(self):
+        trees = [self._tree(i) for i in range(3)]
+        stacked = stacking.stack_trees(trees)
+        assert stacked["w"].shape == (3, 3, 4)
+        back = stacking.unstack_tree(stacked, 3)
+        for orig, got in zip(trees, back):
+            assert_states_equal(got, orig, "roundtrip")
+
+    def test_member_slice_is_checkpoint_view(self):
+        trees = [self._tree(i) for i in range(3)]
+        stacked = stacking.stack_trees(trees)
+        assert_states_equal(stacking.member_slice(stacked, 1), trees[1],
+                            "member 1")
+
+    def test_remove_member_preserves_order(self):
+        trees = [self._tree(i) for i in range(4)]
+        shrunk = stacking.remove_member(stacking.stack_trees(trees), 1)
+        assert shrunk["w"].shape[0] == 3
+        for out_i, src_i in enumerate([0, 2, 3]):
+            assert_states_equal(stacking.member_slice(shrunk, out_i),
+                                trees[src_i], f"survivor {src_i}")
+
+    def test_batch_mismatch_names_the_member(self):
+        good = np.zeros((2, 8), dtype=np.int32)
+        bad = np.zeros((2, 9), dtype=np.int32)
+        with pytest.raises(stacking.MemberShapeError) as ei:
+            stacking.stack_member_batches(
+                [good, bad, good], member_names=["a", "b", "c"])
+        assert "b" in str(ei.value)
+
+
+# ================================================== fingerprint / candidates
+class TestFusionFingerprint:
+    def test_lr_rides_along(self, tmp_path):
+        a = make_member(str(tmp_path / "a"), "fa", 1e-3)
+        b = make_member(str(tmp_path / "b"), "fb", 7e-3)
+        fp_a, fp_b = fused.fusion_fingerprint(a), fused.fusion_fingerprint(b)
+        assert fp_a is not None and fp_a == fp_b
+
+    def test_callable_optimizer_cannot_fuse(self, tmp_path):
+        t = make_member(str(tmp_path / "t"), "ft", 1e-3)
+        t.hparams.optimizer = lambda lr: None
+        t._fusion_fingerprint = False  # drop the cached value
+        assert fused.fusion_fingerprint(t) is None
+
+    def test_candidates_group_and_chunk(self, tmp_path):
+        tasks = [make_member(str(tmp_path / f"m{i}"), f"m{i}", 1e-3 * (i + 1))
+                 for i in range(5)]
+        groups = fused.fusion_candidates(tasks, max_members=3)
+        assert sorted(n for g in groups for n in g) == [
+            f"m{i}" for i in range(5)
+        ]
+        assert all(2 <= len(g) <= 3 for g in groups)
+
+
+# ==================================================================== plan
+class TestPlanFusedWire:
+    def _plan(self):
+        return Plan(
+            assignments={
+                "a": Assignment(1, Block(0, 1), 0.0, 1.0),
+                "b": Assignment(1, Block(0, 1), 0.0, 1.0),
+                "c": Assignment(1, Block(4, 1), 0.0, 1.0),
+            },
+            makespan=1.0,
+            fused=[["a", "b"]],
+        )
+
+    def test_json_roundtrip(self):
+        plan = self._plan()
+        back = Plan.from_json(plan.to_json())
+        assert back.fused == [["a", "b"]]
+        assert back.fused_group_of() == {"a": 0, "b": 0}
+
+    def test_from_json_backcompat(self):
+        d = self._plan().to_json()
+        del d["fused"]
+        assert Plan.from_json(d).fused == []
+
+    def test_dependencies_exempt_fused_members(self):
+        plan = self._plan()
+        plan.compute_dependencies()
+        # a and b share Block(0,1) at the same start but are one stack:
+        # no ordering edge between them
+        assert plan.dependencies["a"] == []
+        assert plan.dependencies["b"] == []
+
+    def test_verifier_exempts_fused_overlap(self):
+        from saturn_tpu.analysis import plan_verifier
+
+        report = plan_verifier.verify_plan(self._plan(), topology=fake_topo())
+        assert not [d for d in report.errors if d.code == "SAT-P001"]
+
+    def test_verifier_flags_divergent_fused_slots(self):
+        from saturn_tpu.analysis import plan_verifier
+
+        plan = Plan(
+            assignments={
+                "a": Assignment(1, Block(0, 1), 0.0, 1.0),
+                "b": Assignment(1, Block(1, 1), 0.0, 1.0),
+            },
+            makespan=1.0,
+            fused=[["a", "b"]],
+        )
+        report = plan_verifier.verify_plan(plan, topology=fake_topo())
+        assert [d for d in report.errors if d.code == "SAT-P025"]
+
+    def test_verifier_warns_on_unpriced_fusion(self):
+        from saturn_tpu.analysis import plan_verifier
+
+        plan = self._plan()
+        tasks = [FakeTask("a", [1]), FakeTask("b", [1], fused_pbt=0.05)]
+        report = plan_verifier.verify_plan(plan, topology=fake_topo(),
+                                           tasks=tasks)
+        warned = [d for d in report.diagnostics if d.code == "SAT-P026"]
+        assert [d.counterexample["task"] for d in warned] == ["a"]
+
+
+# ================================================================= pricing
+class TestFusionPricing:
+    def test_fuses_when_measured_cost_wins(self):
+        tasks = [FakeTask(n, [1, 2], runtime=10.0, pbt=0.1, fused_pbt=0.12)
+                 for n in ("a", "b", "c")]
+        priced = milp.fusion_priced_groups(
+            tasks, [["a", "b", "c"]], fake_topo())
+        assert len(priced) == 1
+        names, size, fused_rt, fpbt = priced[0]
+        assert names == ["a", "b", "c"]
+        # 100 remaining batches x 0.12 s lockstep = 12 s vs 30 s serial
+        assert fused_rt == pytest.approx(12.0)
+        assert fpbt == pytest.approx(0.12)
+
+    def test_never_fuses_on_guesswork(self):
+        # fused_per_batch_time=None at every size: no measured lockstep cost
+        tasks = [FakeTask(n, [1, 2]) for n in ("a", "b")]
+        assert milp.fusion_priced_groups(tasks, [["a", "b"]],
+                                         fake_topo()) == []
+
+    def test_fuses_nothing_when_slower_than_serial(self):
+        # lockstep step 10x a solo batch: serial wins, group refused
+        tasks = [FakeTask(n, [1], runtime=10.0, pbt=0.1, fused_pbt=1.0)
+                 for n in ("a", "b")]
+        assert milp.fusion_priced_groups(tasks, [["a", "b"]],
+                                         fake_topo()) == []
+
+    def test_memlens_gate_vetoes(self):
+        tasks = [FakeTask(n, [1], runtime=10.0, pbt=0.1, fused_pbt=0.12)
+                 for n in ("a", "b")]
+        vetoed = milp.fusion_priced_groups(
+            tasks, [["a", "b"]], fake_topo(),
+            fusion_fits=lambda members, size, n: False)
+        assert vetoed == []
+        unknown = milp.fusion_priced_groups(
+            tasks, [["a", "b"]], fake_topo(),
+            fusion_fits=lambda members, size, n: None)
+        assert len(unknown) == 1
+
+    def test_exclude_shrinks_group(self):
+        tasks = [FakeTask(n, [1], runtime=10.0, pbt=0.1, fused_pbt=0.12)
+                 for n in ("a", "b", "c")]
+        priced = milp.fusion_priced_groups(
+            tasks, [["a", "b", "c"]], fake_topo(), fusion_exclude={"b"})
+        assert priced and priced[0][0] == ["a", "c"]
+
+    def test_solve_emits_fused_plan_with_identical_slots(self):
+        tasks = [FakeTask(n, [1, 2], runtime=10.0, pbt=0.1, fused_pbt=0.12)
+                 for n in ("a", "b", "c")]
+        plan = milp.solve(tasks, fake_topo(), fusion=[["a", "b", "c"]])
+        assert plan.fused == [["a", "b", "c"]]
+        slots = {
+            (a.apportionment, a.block.offset, a.block.size, a.start)
+            for n, a in plan.assignments.items() if n in {"a", "b", "c"}
+        }
+        assert len(slots) == 1
+        from saturn_tpu.analysis import plan_verifier
+
+        report = plan_verifier.verify_plan(plan, topology=fake_topo())
+        assert report.ok, [d.message for d in report.errors]
+
+    def test_solve_falls_back_solo_when_unpriced(self):
+        tasks = [FakeTask(n, [1, 2], runtime=10.0, pbt=0.1)
+                 for n in ("a", "b", "c")]
+        plan = milp.solve(tasks, fake_topo(), fusion=[["a", "b", "c"]])
+        assert plan.fused == []
+
+
+# ============================================================== trajectories
+class TestFusedTrajectory:
+    def test_fused_members_match_solo_bitwise(self, tmp_path, solo_refs):
+        members = [
+            make_member(str(tmp_path / k), f"tr-{k}", lr)
+            for k, lr in SWEEP_LRS.items()
+        ]
+        report = fused.run_fused_interval(
+            members, jax.devices()[:1], 0,
+            batch_counts=[N_BATCHES] * len(members))
+        ckpt.flush()
+        assert report.n_steps == N_BATCHES
+        for t, key in zip(members, SWEEP_LRS):
+            mr = report.members[t.name]
+            assert mr.steps == N_BATCHES and mr.fault is None
+            assert_states_equal(ckpt.load_arrays(t.ckpt_path),
+                                solo_refs[key], t.name)
+
+    def test_sharded_model_axis_matches_solo(self, tmp_path, solo_refs):
+        lrs = [SWEEP_LRS["a"], SWEEP_LRS["b"], SWEEP_LRS["c"], 3e-3]
+        members = [
+            make_member(str(tmp_path / f"s{i}"), f"sh-{i}", lr)
+            for i, lr in enumerate(lrs)
+        ]
+        fused.run_fused_interval(members, jax.devices()[:2], 0,
+                                 batch_counts=[N_BATCHES] * 4)
+        ckpt.flush()
+        assert_states_equal(ckpt.load_arrays(members[0].ckpt_path),
+                            solo_refs["a"], "sharded member 0")
+
+    def test_unfuse_and_solo_resume_is_exact(self, tmp_path, solo_refs):
+        members = [
+            make_member(str(tmp_path / k), f"uf-{k}", lr)
+            for k, lr in SWEEP_LRS.items()
+        ]
+        polls = {"n": 0}
+
+        def detach_b_at_second_boundary(t):
+            if t.name != "uf-b":
+                return False
+            polls["n"] += 1
+            return polls["n"] > 1
+
+        report = fused.run_fused_interval(
+            members, jax.devices()[:1], 0,
+            batch_counts=[N_BATCHES] * 3, window_size=2,
+            detach_requested=detach_b_at_second_boundary)
+        ckpt.flush()
+        assert len(report.detached) == 1
+        detached, steps_done = report.detached[0]
+        assert detached.name == "uf-b" and 0 < steps_done < N_BATCHES
+        assert report.members["uf-b"].detached_at == steps_done
+        # solo resume for the remaining batches restores the exact
+        # uninterrupted-solo trajectory
+        tech = detached.strategies[1].executor
+        tech.execute(detached, jax.devices()[:1], 0,
+                     override_batch_count=N_BATCHES - steps_done)
+        ckpt.flush()
+        assert_states_equal(ckpt.load_arrays(detached.ckpt_path),
+                            solo_refs["b"], "unfused b")
+        for t, key in [(members[0], "a"), (members[2], "c")]:
+            assert report.members[t.name].steps == N_BATCHES
+            assert_states_equal(ckpt.load_arrays(t.ckpt_path),
+                                solo_refs[key], f"survivor {key}")
+
+
+# ============================================================ crash replay
+@pytest.mark.crash
+class TestUnfuseCrashReplay:
+    def test_kill_inside_unfuse_replays_exactly_once(
+            self, tmp_path, solo_refs):
+        """SimulatedKill at the ``fused.unfuse`` barrier: the barrier fires
+        BEFORE the detached member's checkpoint lands, so the kill leaves
+        nothing durable from the interval — replay re-runs it bit-
+        identically, unfuses at the same boundary, and the detached member's
+        solo resume lands exactly on the uninterrupted-solo trajectory (no
+        lost, no duplicated steps)."""
+        from saturn_tpu.resilience import CrashInjector, SimulatedKill
+
+        members = [
+            make_member(str(tmp_path / k), f"cr-{k}", lr)
+            for k, lr in SWEEP_LRS.items()
+        ]
+
+        def make_detach():
+            polls = {"n": 0}
+
+            def cb(t):
+                if t.name != "cr-b":
+                    return False
+                polls["n"] += 1
+                return polls["n"] > 1
+
+            return cb
+
+        inj = CrashInjector("fused.unfuse", hit=1)
+        ckpt.set_crash_barrier(inj.barrier)
+        try:
+            with pytest.raises(SimulatedKill):
+                fused.run_fused_interval(
+                    members, jax.devices()[:1], 0,
+                    batch_counts=[N_BATCHES] * 3, window_size=2,
+                    detach_requested=make_detach())
+            ckpt.flush()
+            # nothing durable for the detached member: the kill preceded
+            # its checkpoint save
+            assert not os.path.exists(members[1].ckpt_path)
+        finally:
+            ckpt.set_crash_barrier(None)
+
+        # replay: the next incarnation re-runs the interval from scratch
+        # (fresh task objects, same configs — nothing was durable)
+        replay = [
+            make_member(str(tmp_path / k), f"cr-{k}", lr)
+            for k, lr in SWEEP_LRS.items()
+        ]
+        report = fused.run_fused_interval(
+            replay, jax.devices()[:1], 0,
+            batch_counts=[N_BATCHES] * 3, window_size=2,
+            detach_requested=make_detach())
+        ckpt.flush()
+        detached, steps_done = report.detached[0]
+        assert detached.name == "cr-b"
+        tech = detached.strategies[1].executor
+        tech.execute(detached, jax.devices()[:1], 0,
+                     override_batch_count=N_BATCHES - steps_done)
+        ckpt.flush()
+        final = ckpt.load_arrays(detached.ckpt_path)
+        assert_states_equal(final, solo_refs["b"], "replayed b")
+        assert int(final["step"]) == N_BATCHES  # exactly once
+        for t, key in [(replay[0], "a"), (replay[2], "c")]:
+            assert_states_equal(ckpt.load_arrays(t.ckpt_path),
+                                solo_refs[key], f"replay survivor {key}")
+
+
+# ================================================================ engine
+class TestEngineFusedLaunch:
+    def test_engine_runs_fused_group_end_to_end(self, tmp_path, solo_refs):
+        from saturn_tpu.executor import engine
+
+        members = [
+            make_member(str(tmp_path / k), f"en-{k}", lr)
+            for k, lr in SWEEP_LRS.items()
+        ]
+        plan = Plan(
+            assignments={
+                t.name: Assignment(1, Block(0, 1), 0.0, 1.0)
+                for t in members
+            },
+            makespan=1.0,
+            fused=[[t.name for t in members]],
+        )
+        plan.compute_dependencies()
+        topo = SliceTopology(jax.devices())
+        errors = engine.execute(
+            members, {t.name: N_BATCHES for t in members}, 120.0, plan, topo)
+        ckpt.flush()
+        assert errors == {}
+        for t, key in zip(members, SWEEP_LRS):
+            assert t.current_batch == N_BATCHES  # cursor advanced once
+            # realized lockstep cost fed back for the solver's next pricing
+            assert t.strategies[1].fused_per_batch_time is not None
+            assert_states_equal(ckpt.load_arrays(t.ckpt_path),
+                                solo_refs[key], f"engine {key}")
+
+
+# ============================================================== trial runner
+class TestProfileFusedGroup:
+    def test_measures_and_installs_lockstep_cost(self, tmp_path):
+        from saturn_tpu.trial_runner import evaluator
+
+        members = [
+            make_member(str(tmp_path / f"p{i}"), f"pf-{i}", 1e-3 * (i + 1))
+            for i in range(2)
+        ]
+        topo = SliceTopology(jax.devices()[:1])
+        measured = evaluator.profile_fused_group(
+            members, topology=topo, steps=2, warmup=1)
+        assert 1 in measured and measured[1] > 0.0
+        for t in members:
+            assert t.strategies[1].fused_per_batch_time == measured[1]
+        # pure measurement: no cursor movement, no checkpoint
+        for t in members:
+            assert t.current_batch == 0
+            assert not os.path.exists(t.ckpt_path)
+
+    def test_rejects_unfusable_group(self, tmp_path):
+        from saturn_tpu.trial_runner import evaluator
+
+        a = make_member(str(tmp_path / "a"), "rx-a", 1e-3)
+        b = make_member(str(tmp_path / "b"), "rx-b", 1e-3)
+        b.hparams.optimizer = lambda lr: None  # unfingerprintable
+        b._fusion_fingerprint = False
+        with pytest.raises(ValueError):
+            evaluator.profile_fused_group(
+                [a, b], topology=SliceTopology(jax.devices()[:1]))
+
+
+# ================================================================ prefetch
+class TestStackedShapeContract:
+    def test_prefetcher_blames_the_group(self):
+        from saturn_tpu.data.prefetch import DevicePrefetcher, ShapeContractError
+
+        good = np.zeros((3, 2, 8), dtype=np.int32)
+        bad = np.zeros((2, 2, 8), dtype=np.int32)
+        pf = DevicePrefetcher(
+            2, lambda i: good if i == 0 else bad,
+            expect_shapes=[(3, 2, 8)], member_names=["a", "b", "c"])
+        try:
+            assert next(pf) is good
+            with pytest.raises(ShapeContractError) as ei:
+                next(pf)
+        finally:
+            pf.close()
+        msg = str(ei.value)
+        assert "(2, 2, 8)" in msg and "a" in msg
+        assert ei.value.member_names == ["a", "b", "c"]
+
+
+# ================================================================= memlens
+class TestFusedStackFits:
+    def test_unknown_without_capacity(self):
+        from saturn_tpu.analysis.memlens import passes as ml_passes
+
+        verdict = ml_passes.fused_stack_fits(
+            object(), object(), [FakeDev()], 4, capacity_bytes=0)
+        assert verdict is None
+
+    def test_fits_and_vetoes_on_real_trace(self, tmp_path):
+        from saturn_tpu.analysis.memlens import passes as ml_passes
+
+        t = make_member(str(tmp_path / "m"), "ml-m", 1e-3)
+        tech = DataParallel()
+        devs = jax.devices()[:1]
+        roomy = ml_passes.fused_stack_fits(
+            tech, t, devs, 4, capacity_bytes=1 << 40)
+        tight = ml_passes.fused_stack_fits(
+            tech, t, devs, 4, capacity_bytes=1 << 10)
+        assert roomy is True
+        assert tight is False
