@@ -648,6 +648,101 @@ def validate_fused_row(row) -> list:
     return problems
 
 
+#: Required key -> type for the ``benchmarks/twin_scale.py`` row. Same
+#: contract as the other ROW_REQUIRED tables: the bench self-validates
+#: before printing, and recorded rows can be re-checked without re-running.
+TWIN_ROW_REQUIRED = {
+    "metric": str,               # "twin_scale"
+    "mode": str,                 # "quick" or "full"
+    "n_jobs": int,               # full mode: >= 100_000 synthesized jobs
+    "n_slices": int,             # full mode: >= 32 virtual slices
+    "chips": int,
+    "submitted": int,            # accepted by the real gateway
+    "scheduled": int,            # ADMITted by the real admission controller
+    "completed": int,
+    "failed": int,
+    "evicted": int,
+    "shed": int,                 # gateway sheds (window/deadline/draining)
+    "solves": int,               # real anytime_resolve calls
+    "deadline_misses": int,      # hard acceptance bar: must be 0
+    "tier_counts": dict,         # solver tier -> adoption count
+    "makespan_sim_s": float,     # simulated campaign makespan
+    "wall_s": float,             # real seconds the campaign took
+    "seed": int,
+    "fidelity": dict,            # twin-vs-real band check (may be empty
+    #                              when the fidelity phase was skipped)
+    "status": str,
+}
+
+
+def validate_twin_row(row) -> list:
+    """Schema-check one twin-scale row; returns human-readable problems
+    (empty list = valid).
+
+    Enforces the twin's acceptance bars: zero solver deadline misses, the
+    full-mode scale floor (>= 100k jobs over >= 32 virtual slices), a
+    conservation check (every scheduled job reaches exactly one terminal
+    verdict), and — when a fidelity phase ran — ``within_band``."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in TWIN_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "twin_scale":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'twin_scale'"
+        )
+    dm = row.get("deadline_misses")
+    if isinstance(dm, int) and not isinstance(dm, bool) and dm != 0:
+        problems.append(
+            f"deadline_misses {dm} != 0 (a twin re-solve blew its real-"
+            "clock budget)"
+        )
+    if row.get("mode") == "full":
+        nj, ns = row.get("n_jobs"), row.get("n_slices")
+        if isinstance(nj, int) and not isinstance(nj, bool) and nj < 100_000:
+            problems.append(f"full-mode n_jobs {nj} < 100000")
+        if isinstance(ns, int) and not isinstance(ns, bool) and ns < 32:
+            problems.append(f"full-mode n_slices {ns} < 32")
+    ints = {k: row.get(k)
+            for k in ("scheduled", "completed", "failed", "evicted")}
+    if all(isinstance(v, int) and not isinstance(v, bool)
+           for v in ints.values()):
+        done = ints["completed"] + ints["failed"] + ints["evicted"]
+        if done < ints["scheduled"]:
+            problems.append(
+                f"completed+failed+evicted {done} < scheduled "
+                f"{ints['scheduled']} (jobs left in limbo)"
+            )
+    tc = row.get("tier_counts")
+    if isinstance(tc, dict):
+        bad = [k for k, v in tc.items()
+               if not isinstance(k, str)
+               or isinstance(v, bool) or not isinstance(v, int)]
+        if bad:
+            problems.append(f"tier_counts has non-(str -> int) entries: {bad}")
+        if not tc and row.get("solves", 0):
+            problems.append("solves > 0 but tier_counts is empty")
+    fid = row.get("fidelity")
+    if isinstance(fid, dict) and fid and fid.get("within_band") is not True:
+        problems.append(
+            "fidelity.within_band is not True (the twin's tier/verdict/"
+            "makespan distributions drifted outside the documented band)"
+        )
+    return problems
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (

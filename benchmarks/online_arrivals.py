@@ -56,6 +56,7 @@ from saturn_tpu.service import (
     ServiceClient,
 )
 from saturn_tpu.service.gateway import protocol
+from saturn_tpu.twin.arrivals import BURST_EVERY, BURST_LEN, arrival_stream
 from saturn_tpu.utils.metrics import read_events
 
 N_JOBS = 6
@@ -66,13 +67,13 @@ SEED = 7
 
 # Gateway-phase traffic shape: a Poisson base rate with periodic diurnal
 # bursts (every cycle, a burst window arrives at burst_rate instead). The
-# inflight window is sized so bursts overrun it — shed behavior is the
-# point, not an accident.
+# burst cycle constants (BURST_EVERY/BURST_LEN) and the generator itself
+# live in saturn_tpu.twin.arrivals — one seeded stream shared with the twin
+# simulator, so bench and twin traces can't drift. The inflight window is
+# sized so bursts overrun it — shed behavior is the point, not an accident.
 N_ONLINE = 200
 BASE_RATE_HZ = 12.0
 BURST_RATE_HZ = 80.0
-BURST_EVERY = 50          # every 50 arrivals, a burst window opens...
-BURST_LEN = 20            # ...for 20 arrivals
 GATEWAY_WINDOW = 12       # gateway max_inflight (solver size stays bounded)
 ONLINE_BATCHES = 2        # tiny jobs: the wire, not the mesh, is measured
 
@@ -192,7 +193,8 @@ def run_gateway_phase(topo: SliceTopology, *,
                       drain: bool = True,
                       settle_s: float = 0.0,
                       session_window: int = 16,
-                      seed: int = SEED) -> dict:
+                      seed: int = SEED,
+                      durability_dir: str = None) -> dict:
     """Drive ``n_jobs`` jobs through the gateway under Poisson + bursts.
 
     Clients run with ``max_attempts=1`` on purpose: a shed is *counted*, not
@@ -204,32 +206,36 @@ def run_gateway_phase(topo: SliceTopology, *,
     long ``batches`` so arrivals outlive the run, ``drain=False`` (reach
     full depth and measure re-solves, don't wait out a multi-hour
     makespan), and ``metrics_path`` to capture the ``solver_tier`` events.
+
+    ``durability_dir`` turns on the service's write-ahead journal: the run
+    leaves a replayable trace behind, which is how the twin's fidelity
+    check gets its ground truth (``saturn_tpu.twin.trace.load_trace``).
     """
     tech = BenchTech()
     svc = SaturnService(
         topology=topo, interval=interval, poll_s=0.02,
         task_provider=_online_provider(tech), health_guardian=False,
-        metrics_path=metrics_path,
+        metrics_path=metrics_path, durability_dir=durability_dir,
     ).start()
     gw = GatewayServer(svc, max_inflight=window,
                        max_inflight_per_session=session_window)
     gw.start()
-    rng = random.Random(seed)
+    trace = arrival_stream(n_jobs, base_rate_hz=base_rate_hz,
+                           burst_rate_hz=burst_rate_hz, seed=seed)
     latencies, accepted, shed = [], [], 0
     t0 = time.monotonic()
     try:
         with GatewayClient(*gw.address, session="bench-online",
                            seed=seed, timeout_s=30.0,
                            max_attempts=1) as client:
-            for i in range(n_jobs):
-                in_burst = (i % BURST_EVERY) < BURST_LEN
-                rate = burst_rate_hz if in_burst else base_rate_hz
-                time.sleep(rng.expovariate(rate))
+            for arr in trace:
+                i = arr.index
+                time.sleep(arr.gap_s)
                 t_submit = time.monotonic()
                 try:
                     jid = client.submit(
                         name=f"online-{i}", total_batches=batches,
-                        priority=float(rng.randint(0, 2)),
+                        priority=arr.priority,
                         spec={"sizes": [4, 8]},
                     )
                 except GatewayError as e:

@@ -1,0 +1,188 @@
+"""Twin at scale: the real control plane over 100k jobs and 32 virtual slices.
+
+Two phases, both through :mod:`saturn_tpu.twin`:
+
+1. **Scale row** — synthesize >= 100k jobs (Poisson + diurnal bursts, the
+   same seeded generator the gateway bench uses) against a 32-slice /
+   256-chip virtual fleet. Every submission passes through the *real*
+   gateway window, the *real* admission controller and the *real* anytime
+   solver tier ladder racing its actual CPU-time deadline — only chip time
+   and the clock are simulated. Acceptance bar: **zero** solver deadline
+   misses across the whole campaign.
+
+2. **Fidelity row** — run the real gateway bench (``benchmarks/
+   online_arrivals.py``, 500 jobs over real sockets and threads) with its
+   write-ahead journal on, replay that journal through the twin, and check
+   the twin's solver-tier shares / admission verdict mix / makespan against
+   journaled reality within the documented band
+   (``saturn_tpu.twin.trace.DEFAULT_BAND``).
+
+Prints one JSON line per phase (the scale row last — it is the headline)
+and self-validates against ``bench_guard.TWIN_ROW_REQUIRED`` before
+printing:
+
+    {"metric": "twin_fidelity", "within_band": true, ...}
+    {"metric": "twin_scale", "n_jobs": 100000, "n_slices": 32,
+     "deadline_misses": 0, "tier_counts": {...}, "status": "ok", ...}
+
+Run: ``python benchmarks/twin_scale.py`` (``--quick`` shrinks the scale
+phase to 2k jobs / 8 slices for smoke runs; ``--skip-fidelity`` drops the
+real-service phase).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from saturn_tpu.twin.runner import CampaignConfig, run_campaign
+from saturn_tpu.twin.trace import fidelity_compare, load_trace, tier_shares
+
+SEED = 7
+
+#: Scale-phase shape. ~2.4k arrivals per 600-simulated-second interval keep
+#: the live set at a size the LP-rounding tier solves in ~2-4s of real CPU
+#: time — comfortably inside the 5s budget (measured; the zero-deadline-miss
+#: bar is checked, not assumed). The inflight window is sized ABOVE the peak
+#: live set on purpose: this row measures scheduling throughput, and a shed
+#: job never reaches the solver (the shed path gets its workout from the
+#: gateway bench and the fidelity replay below).
+FULL = dict(n_jobs=100_000, n_slices=32, base_rate_hz=4.0,
+            burst_rate_hz=12.0, max_inflight=8_000)
+QUICK = dict(n_jobs=2_000, n_slices=8, base_rate_hz=4.0,
+             burst_rate_hz=12.0, max_inflight=4_000)
+
+#: Fidelity-phase twin shape: must mirror the real gateway bench exactly —
+#: same 8-chip mesh, same 0.2s interval (deadline = interval/2), same window,
+#: and the bench's pre-profiled flat per-batch cost.
+FIDELITY_JOBS = 500
+FIDELITY_TWIN = dict(
+    n_slices=1, chips_per_slice=8, interval_s=0.2, solve_deadline_s=0.1,
+    max_inflight=12, flat_per_batch_s=0.004, metrics=False, seed=SEED,
+)
+
+
+def run_scale_phase(mode: str, out_dir: str, fidelity: dict) -> dict:
+    shape = FULL if mode == "full" else QUICK
+    cfg = CampaignConfig(
+        n_jobs=shape["n_jobs"], n_slices=shape["n_slices"],
+        chips_per_slice=8, interval_s=600.0, solve_deadline_s=5.0,
+        base_rate_hz=shape["base_rate_hz"],
+        burst_rate_hz=shape["burst_rate_hz"],
+        total_batches=3, max_inflight=shape["max_inflight"],
+        metrics=False, compact_every=8, seed=SEED, max_intervals=400,
+    )
+    s = run_campaign(cfg, out_dir)
+    return {
+        "metric": "twin_scale",
+        "mode": mode,
+        "n_jobs": cfg.n_jobs,
+        "n_slices": cfg.n_slices,
+        "chips": cfg.n_slices * cfg.chips_per_slice,
+        "submitted": s["submitted"],
+        "scheduled": s["admission"].get("admit", 0),
+        "completed": s["completed"],
+        "failed": s["failed"],
+        "evicted": s["evicted"],
+        "shed": s["shed_total"],
+        "solves": s["solves"],
+        "deadline_misses": s["deadline_misses"],
+        "tier_counts": s["tier_counts"],
+        "intervals": s["intervals"],
+        "makespan_sim_s": s["makespan_s"],
+        "wall_s": s["wall_s"],
+        "sim_speedup": s["sim_speedup"],
+        "seed": SEED,
+        "fidelity": fidelity,
+        "status": s["status"],
+    }
+
+
+def run_fidelity_phase(work_dir: str) -> dict:
+    """Real gateway run -> journal -> twin replay -> band comparison."""
+    from online_arrivals import run_gateway_phase
+    from saturn_tpu import library as lib
+    from saturn_tpu.core.mesh import SliceTopology
+    import online_arrivals
+
+    lib.register("bench-online", online_arrivals.BenchTech)
+    topo = SliceTopology([online_arrivals.FakeDev() for _ in range(8)])
+    durability_dir = os.path.join(work_dir, "real-journal")
+    metrics_path = os.path.join(work_dir, "real-metrics.jsonl")
+    real_row = run_gateway_phase(
+        topo, n_jobs=FIDELITY_JOBS, durability_dir=durability_dir,
+        metrics_path=metrics_path, seed=SEED,
+    )
+    real_trace = load_trace(durability_dir)
+    real_side = {
+        "tier_shares": tier_shares(metrics_path),
+        "verdict_shares": real_trace.verdict_shares,
+        "makespan_s": real_row["makespan_s"],
+    }
+    twin_cfg = CampaignConfig(trace_dir=durability_dir, **FIDELITY_TWIN)
+    twin = run_campaign(twin_cfg, os.path.join(work_dir, "twin-replay"))
+    twin_side = {
+        "tier_shares": twin["tier_shares"],
+        "verdict_shares": twin["verdict_shares"],
+        "makespan_s": twin["makespan_s"],
+    }
+    cmp = fidelity_compare(twin_side, real_side)
+    return {
+        "metric": "twin_fidelity",
+        "n_jobs": FIDELITY_JOBS,
+        "real_accepted": real_row["accepted"],
+        "real_shed": real_row["shed"],
+        "twin_submitted": twin["submitted"],
+        "twin_shed": twin["shed_total"],
+        "twin_tier_shares": twin_side["tier_shares"],
+        "real_tier_shares": real_side["tier_shares"],
+        "twin_verdict_shares": twin_side["verdict_shares"],
+        "real_verdict_shares": real_side["verdict_shares"],
+        "twin_makespan_s": twin_side["makespan_s"],
+        "real_makespan_s": real_side["makespan_s"],
+        "deadline_misses": twin["deadline_misses"],
+        "seed": SEED,
+        **cmp,
+    }
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv[1:]
+    skip_fidelity = "--skip-fidelity" in sys.argv[1:]
+    work_dir = tempfile.mkdtemp(prefix="twin_scale_")
+    try:
+        fidelity: dict = {}
+        if not skip_fidelity:
+            fid_row = run_fidelity_phase(work_dir)
+            print(json.dumps(fid_row))
+            fidelity = {
+                "within_band": fid_row["within_band"],
+                "tier_share_deltas": fid_row["tier_share_deltas"],
+                "verdict_share_deltas": fid_row["verdict_share_deltas"],
+                "makespan_ratio": fid_row["makespan_ratio"],
+            }
+        row = run_scale_phase(
+            "quick" if quick else "full",
+            os.path.join(work_dir, "scale"), fidelity,
+        )
+        import bench_guard
+        problems = bench_guard.validate_twin_row(row)
+        if problems:
+            raise SystemExit(f"twin row failed self-validation: {problems}")
+        print(json.dumps(row))
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -257,6 +257,75 @@ def replay(root: str, strict: bool = False) -> List[Dict[str, Any]]:
     return out
 
 
+def replay_reconciled(root: str) -> List[Dict[str, Any]]:
+    """Read every durable record across *all* writer incarnations, in a
+    stable ``(seq, incarnation)`` order, deduplicating overlapping sequence
+    ranges in favor of the latest incarnation.
+
+    :func:`replay` assumes a single totally-ordered writer history: it
+    demands ``seq == prev_seq + 1`` across segment boundaries and silently
+    stops at the first discontinuity. That is the right paranoia for crash
+    *recovery* — but it silently discards valid history when a restarted
+    incarnation began from an **older durable cut** than the bytes a reader
+    can now see (an unacknowledged tail that later became visible, an
+    ``sync=False`` page-cache survivor, a split-brain writer): the second
+    incarnation's segments re-use sequence numbers the first already
+    emitted, so strict replay drops the entire later incarnation.
+
+    For trace analysis (the twin's journal loader) we want the union
+    instead: each segment is CRC-verified and read up to its own torn tail,
+    segments are grouped into incarnations (a new incarnation starts
+    whenever a segment's first sequence number does not continue the
+    previous segment's), and the merged stream is stable-sorted by
+    ``(seq, incarnation)``. Where two incarnations emitted the same ``seq``,
+    the later incarnation's record wins — it is the one whose writer went on
+    to extend the history. Non-mutating; never raises on corruption.
+    """
+    tagged: List[Tuple[int, int, Dict[str, Any]]] = []
+    if not os.path.isdir(root):
+        return []
+    segments = sorted(
+        (idx, name) for name in os.listdir(root)
+        if (idx := _segment_index(name)) is not None
+    )
+    incarnation = -1
+    prev_last: Optional[int] = None
+    for _idx, name in segments:
+        seg_path = os.path.join(root, name)
+        with open(seg_path, "rb") as f:
+            raw = f.read()
+        offset = 0
+        seg_prev: Optional[int] = None
+        seg_records: List[Dict[str, Any]] = []
+        while offset < len(raw):
+            nl = raw.find(b"\n", offset)
+            if nl < 0:
+                break
+            rec = _verify_line(raw[offset:nl].decode("utf-8", "replace"),
+                               seg_prev)
+            if rec is None:
+                break  # torn tail / corruption: keep the segment's prefix
+            seg_prev = rec["seq"]
+            seg_records.append(rec)
+            offset = nl + 1
+        if not seg_records:
+            continue
+        first = seg_records[0]["seq"]
+        if prev_last is None or first != prev_last + 1:
+            incarnation += 1  # seq discontinuity = a writer (re)start
+        prev_last = seg_records[-1]["seq"]
+        for rec in seg_records:
+            tagged.append((rec["seq"], incarnation, rec))
+    tagged.sort(key=lambda t: (t[0], t[1]))
+    out: List[Dict[str, Any]] = []
+    for seq, _inc, rec in tagged:
+        if out and out[-1]["seq"] == seq:
+            out[-1] = rec  # later incarnation overwrites the same seq
+        else:
+            out.append(rec)
+    return out
+
+
 class Journal:
     """The write-ahead journal: append/commit over rotating segments.
 

@@ -177,6 +177,20 @@ class FleetHealthMonitor:
                 for i in device_indices
             )
 
+    def max_slowdown(self, device_indices: Sequence[int]) -> float:
+        """Worst injected/observed slowdown factor across a block's devices
+        (1.0 = nominal). Simulated engines use this to inflate realized
+        per-batch time the same way a real straggler chip would."""
+        with self._lock:
+            return max(
+                (
+                    self._devices[i].slowdown
+                    for i in device_indices
+                    if i in self._devices
+                ),
+                default=1.0,
+            )
+
     def stragglers(self) -> List[int]:
         """Devices whose latency EWMA exceeds straggler_factor x fleet
         median (alive devices with at least one observation)."""

@@ -151,6 +151,11 @@ class SubmissionQueue:
         self._cond = tsan.condition(self._lock, "queue.cond")
         self._jobs: Dict[str, JobRecord] = {}
         self._arrivals: List[str] = []   # job_ids waiting for the next drain
+        #: name -> job_id for every non-terminal job. The uniqueness check in
+        #: submit/restore and the ``live()`` gauge read this instead of
+        #: scanning the whole registry — at twin-campaign scale (100k+
+        #: submissions) the O(all-jobs-ever) scan per submit is quadratic.
+        self._live_names: Dict[str, str] = {}
         self._seq = 0
         #: Optional ``observer(event, rec, **fields)`` called under the queue
         #: lock after every registry mutation ("submitted" / "state" /
@@ -176,13 +181,14 @@ class SubmissionQueue:
             raise ValueError("JobRequest.task must have a non-empty .name")
         sched_point("queue.submit")
         with self._lock:
-            for rec in self._jobs.values():
-                if rec.name == name and rec.state not in TERMINAL_STATES:
-                    raise ValueError(
-                        f"task name {name!r} is already live as {rec.job_id} "
-                        f"({rec.state.value}) — task names must be unique "
-                        "among active jobs"
-                    )
+            live_id = self._live_names.get(name)
+            if live_id is not None:
+                rec = self._jobs[live_id]
+                raise ValueError(
+                    f"task name {name!r} is already live as {rec.job_id} "
+                    f"({rec.state.value}) — task names must be unique "
+                    "among active jobs"
+                )
             self._seq += 1
             now = time.monotonic()
             rec = JobRecord(
@@ -195,6 +201,7 @@ class SubmissionQueue:
                 ),
             )
             self._jobs[rec.job_id] = rec
+            self._live_names[name] = rec.job_id
             self._arrivals.append(rec.job_id)
             self._notify_observer("submitted", rec)
             self._cond.notify_all()
@@ -219,13 +226,14 @@ class SubmissionQueue:
             if rec.job_id in self._jobs:
                 raise ValueError(f"job id {rec.job_id!r} already registered")
             if rec.state not in TERMINAL_STATES:
-                for other in self._jobs.values():
-                    if other.name == name and other.state not in TERMINAL_STATES:
-                        raise ValueError(
-                            f"task name {name!r} is already live as "
-                            f"{other.job_id} ({other.state.value}) — cannot "
-                            f"restore {rec.job_id}"
-                        )
+                live_id = self._live_names.get(name)
+                if live_id is not None:
+                    other = self._jobs[live_id]
+                    raise ValueError(
+                        f"task name {name!r} is already live as "
+                        f"{other.job_id} ({other.state.value}) — cannot "
+                        f"restore {rec.job_id}"
+                    )
             try:  # job_id format: j{seq:04d}-{name}
                 recovered_seq = int(rec.job_id[1:].split("-", 1)[0])
             except (ValueError, IndexError):
@@ -233,6 +241,7 @@ class SubmissionQueue:
             self._seq = max(self._seq, recovered_seq)
             self._jobs[rec.job_id] = rec
             if rec.state not in TERMINAL_STATES:
+                self._live_names[name] = rec.job_id
                 if rec.job_id not in self._arrivals:
                     self._arrivals.append(rec.job_id)
                 self._notify_observer("recovered", rec)
@@ -294,6 +303,9 @@ class SubmissionQueue:
                     f"{state.value} for {rec.job_id}"
                 )
             rec.state = state
+            if state in TERMINAL_STATES:
+                if self._live_names.get(rec.name) == rec.job_id:
+                    del self._live_names[rec.name]
             now = time.monotonic()
             if state is JobState.SCHEDULED:
                 if rec.admitted_at is None:  # first admission outcome
@@ -332,10 +344,22 @@ class SubmissionQueue:
     def live(self) -> int:
         """Jobs in any non-terminal state."""
         with self._lock:
-            return sum(
-                1 for r in self._jobs.values()
-                if r.state not in TERMINAL_STATES
-            )
+            return len(self._live_names)
+
+    def compact(self) -> int:
+        """Drop terminal job records from the registry; returns how many were
+        removed. ``status``/``wait`` stop answering for compacted ids, so this
+        is for long-running campaign drivers (the twin runs 100k+ jobs through
+        one queue) — the interactive service keeps its full history."""
+        sched_point("queue.compact")
+        with self._lock:
+            dead = [
+                jid for jid, r in self._jobs.items()
+                if r.state in TERMINAL_STATES
+            ]
+            for jid in dead:
+                del self._jobs[jid]
+            return len(dead)
 
     def wait(self, job_id: str, timeout: Optional[float] = None) -> JobRecord:
         """Block until the job reaches a terminal state (or raise

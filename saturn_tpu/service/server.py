@@ -750,41 +750,59 @@ class SaturnService:
 
     def _shed_pressure(self, jobs: Dict[str, JobRecord], topo,
                        plan: Optional[milp.Plan]) -> None:
-        """Deadline-protecting load shed. The tightest remaining deadline
-        slack bounds the projected (greedy, pessimistic) makespan; when the
-        projection overshoots, the configured replanner eviction policy
-        picks the casualties — lowest ``hints['priority']`` first."""
-        with_deadline = [r for r in jobs.values()
-                         if r.deadline_at is not None]
-        if not with_deadline or len(jobs) <= 1:
-            return
-        limit = min(r.deadline_at for r in with_deadline) - time.monotonic()
-        limit = max(limit, 1e-3)
-        tasks = [r.task for r in jobs.values()]
-        # Pessimistic greedy projection; the frontier variant keeps this
-        # O(N * capacity) once the live set outgrows backfill scheduling.
-        if len(tasks) > 300:
-            proj = anytime.fast_greedy_plan(tasks, topo).makespan
-        else:
-            proj = milp.greedy_plan(tasks, topo).makespan
-        if proj <= limit:
-            return
-        from saturn_tpu.resilience.replan import ReplanContext, get_policy
-
-        ctx = ReplanContext(
-            topology=topo, previous_plan=plan, previous_makespan=limit,
-            change_kind="admission-pressure", degrade_factor=1.0,
+        shed, proj, limit = project_pressure_shed(
+            jobs, topo, plan, self.pressure_policy
         )
-        _keep, shed = get_policy(self.pressure_policy)(tasks, ctx)
         if shed:
             # Signal wire-level backpressure: the gateway shrinks its
             # admission window while this timestamp is fresh.
             self.last_pressure_shed = time.monotonic()
-        for t in shed:
-            rec = jobs.get(t.name)
-            if rec is not None:
-                logger.warning(
-                    "admission pressure: evicting %s (projection %.2fs > "
-                    "slack %.2fs)", rec.job_id, proj, limit,
-                )
-                self._evict(jobs, rec, "admission-pressure")
+        for rec in shed:
+            logger.warning(
+                "admission pressure: evicting %s (projection %.2fs > "
+                "slack %.2fs)", rec.job_id, proj, limit,
+            )
+            self._evict(jobs, rec, "admission-pressure")
+
+
+def project_pressure_shed(jobs: Dict[str, JobRecord], topo,
+                          plan: Optional[milp.Plan],
+                          pressure_policy: str):
+    """Deadline-protecting load shed. The tightest remaining deadline
+    slack bounds the projected (greedy, pessimistic) makespan; when the
+    projection overshoots, the configured replanner eviction policy
+    picks the casualties — lowest ``hints['priority']`` first.
+
+    Module-level so simulated loop drivers (the twin campaign runner) run
+    the *identical* shedding decision the service does; returns
+    ``(records_to_evict, projected_makespan, slack_limit)`` and leaves the
+    eviction side effects to the caller.
+    """
+    with_deadline = [r for r in jobs.values()
+                     if r.deadline_at is not None]
+    if not with_deadline or len(jobs) <= 1:
+        return [], 0.0, 0.0
+    limit = min(r.deadline_at for r in with_deadline) - time.monotonic()
+    limit = max(limit, 1e-3)
+    tasks = [r.task for r in jobs.values()]
+    # Pessimistic greedy projection; the frontier variant keeps this
+    # O(N * capacity) once the live set outgrows backfill scheduling.
+    if len(tasks) > 300:
+        proj = anytime.fast_greedy_plan(tasks, topo).makespan
+    else:
+        proj = milp.greedy_plan(tasks, topo).makespan
+    if proj <= limit:
+        return [], proj, limit
+    from saturn_tpu.resilience.replan import ReplanContext, get_policy
+
+    ctx = ReplanContext(
+        topology=topo, previous_plan=plan, previous_makespan=limit,
+        change_kind="admission-pressure", degrade_factor=1.0,
+    )
+    _keep, shed = get_policy(pressure_policy)(tasks, ctx)
+    by_name = {r.name: r for r in jobs.values()}
+    return (
+        [by_name[t.name] for t in shed if t.name in by_name],
+        proj,
+        limit,
+    )

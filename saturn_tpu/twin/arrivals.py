@@ -1,0 +1,73 @@
+"""Seeded Poisson + diurnal-burst arrival synthesis.
+
+Extracted from ``benchmarks/online_arrivals.py`` so the twin and the bench
+draw the *same* trace from the same seed and can never drift. The generator
+consumes its RNG in exactly the order the bench's submit loop always did —
+one ``expovariate`` gap, then one ``randint`` priority, per arrival — so
+seed 7 still produces the historical gateway-bench trace draw for draw.
+
+Traffic shape: a Poisson base rate modulated by periodic diurnal bursts —
+every ``burst_every`` arrivals, a window of ``burst_len`` arrivals comes in
+at ``burst_rate_hz`` instead of ``base_rate_hz`` (the arrival pattern a
+serving front door actually sees). Scaling the rates up by orders of
+magnitude (the twin's "million-user" campaigns) preserves the shape.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List
+
+#: Diurnal-burst cycle defaults (historically the bench module constants).
+BURST_EVERY = 50          # every 50 arrivals, a burst window opens...
+BURST_LEN = 20            # ...for 20 arrivals
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One synthesized arrival on the stream's own time axis."""
+
+    index: int
+    at_s: float       # offset from stream start (cumulative gaps)
+    gap_s: float      # the inter-arrival gap drawn for this arrival
+    priority: float   # integer-valued priority class, 0.0 .. 2.0
+    in_burst: bool    # whether this arrival fell inside a burst window
+
+
+def arrival_stream(n_jobs: int, *,
+                   base_rate_hz: float,
+                   burst_rate_hz: float,
+                   burst_every: int = BURST_EVERY,
+                   burst_len: int = BURST_LEN,
+                   seed: int = 0) -> List[Arrival]:
+    """Synthesize a deterministic arrival trace.
+
+    Same ``(n_jobs, rates, cycle, seed)`` → the identical list, on every
+    platform CPython's Mersenne Twister runs on. Raises on nonsensical
+    rates rather than emitting an empty or divergent stream.
+    """
+    if n_jobs < 0:
+        raise ValueError(f"n_jobs must be >= 0, got {n_jobs}")
+    if base_rate_hz <= 0 or burst_rate_hz <= 0:
+        raise ValueError(
+            f"arrival rates must be positive, got base={base_rate_hz} "
+            f"burst={burst_rate_hz}"
+        )
+    if burst_every <= 0 or burst_len < 0:
+        raise ValueError(
+            f"burst cycle must satisfy burst_every > 0 and burst_len >= 0, "
+            f"got every={burst_every} len={burst_len}"
+        )
+    rng = random.Random(seed)
+    out: List[Arrival] = []
+    t = 0.0
+    for i in range(n_jobs):
+        in_burst = (i % burst_every) < burst_len
+        rate = burst_rate_hz if in_burst else base_rate_hz
+        gap = rng.expovariate(rate)
+        priority = float(rng.randint(0, 2))
+        t += gap
+        out.append(Arrival(index=i, at_s=t, gap_s=gap,
+                           priority=priority, in_burst=in_burst))
+    return out

@@ -1,0 +1,111 @@
+"""Virtual time for the twin: one clock, one deterministic event queue.
+
+The twin's central trick is that the real control-plane code runs
+*unmodified* against simulated time. While :meth:`VirtualClock.patch` is
+active, every ``time.time()`` / ``time.monotonic()`` /
+``timeit.default_timer()`` call anywhere in the process reads the virtual
+clock, and ``time.sleep()`` advances it instead of blocking — so queue
+timestamps, deadline slack, gateway backpressure cooldowns, journal ``ts``
+fields and metrics timestamps all live on the simulated axis and are
+bit-reproducible from a seed.
+
+``time.perf_counter`` is deliberately **not** patched: the anytime solver
+races its tier ladder against real CPU time, and that race — including any
+deadline miss — is precisely what the twin must measure honestly rather
+than simulate away. Wall-clock solver cost is the one "real" quantity a
+campaign reports.
+
+Single-threaded by contract: the campaign loop owns the process while the
+patch is active. Patching module attributes is process-global, so nothing
+else (no live service, no engine launcher threads) may run concurrently —
+the runner enforces this by never calling ``start()`` on the gateway and
+driving every step inline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import heapq
+import itertools
+import time
+import timeit
+from typing import Any, Iterator, List, Optional, Tuple
+
+
+class VirtualClock:
+    """Monotonic simulated clock (seconds, starts at ``start``)."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self, *_args) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"cannot advance the clock by {dt}s")
+        self._now += float(dt)
+        return self._now
+
+    def advance_to(self, t: float) -> float:
+        """Jump forward to absolute time ``t`` (no-op if already past it)."""
+        if t > self._now:
+            self._now = float(t)
+        return self._now
+
+    def sleep(self, dt: float) -> None:
+        """Stand-in for ``time.sleep`` inside the patch: advances instead of
+        blocking (negative durations clamp to zero like the real one)."""
+        self.advance(max(0.0, float(dt)))
+
+    @contextlib.contextmanager
+    def patch(self) -> Iterator["VirtualClock"]:
+        """Swap ``time.time``/``time.monotonic``/``time.sleep`` and
+        ``timeit.default_timer`` for this clock; restore on exit.
+        ``time.perf_counter`` stays real (see module docstring)."""
+        saved = (time.time, time.monotonic, time.sleep, timeit.default_timer)
+        time.time = self.now
+        time.monotonic = self.now
+        time.sleep = self.sleep
+        timeit.default_timer = self.now
+        try:
+            yield self
+        finally:
+            (time.time, time.monotonic,
+             time.sleep, timeit.default_timer) = saved
+
+
+class EventQueue:
+    """Deterministic time-ordered event queue.
+
+    Ties on the timestamp break by insertion order (a monotone counter), so
+    two runs that push the same events in the same order pop them in the
+    same order — the property the bit-identical-replay tests rely on.
+    """
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, str, Any]] = []
+        self._counter = itertools.count()
+
+    def push(self, at: float, kind: str, payload: Any = None) -> None:
+        heapq.heappush(
+            self._heap, (float(at), next(self._counter), kind, payload)
+        )
+
+    def peek_time(self) -> Optional[float]:
+        return self._heap[0][0] if self._heap else None
+
+    def pop_due(self, now: float) -> List[Tuple[float, str, Any]]:
+        """Pop every event with timestamp <= ``now`` (in order)."""
+        out: List[Tuple[float, str, Any]] = []
+        while self._heap and self._heap[0][0] <= now:
+            at, _n, kind, payload = heapq.heappop(self._heap)
+            out.append((at, kind, payload))
+        return out
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    @property
+    def empty(self) -> bool:
+        return not self._heap

@@ -1,0 +1,104 @@
+"""VirtualEngine: the engine surface with simulated execution.
+
+The real engine (``executor/engine.py``) is two things: pure forecast
+arithmetic (which tasks run this interval, for how many batches) and a
+gang launch that actually burns chip time. The twin keeps the first —
+:func:`forecast` / :func:`rollback_forecast` are re-exported *verbatim*,
+because the batch-budget math is part of what the twin exists to validate
+— and replaces the second with bookkeeping on the virtual clock:
+
+- mid-interval fault events land on the health monitor directly (the real
+  engine arms wall-clock watchdog timers; in virtual time the interval is
+  atomic, so "during the interval" means "before its work retires");
+- a task whose assigned block lost a device raises
+  :class:`~saturn_tpu.resilience.faults.PreemptedError` into the errors
+  dict — the same requeue-without-retry contract the service loop applies;
+- ``FaultInjector.crashes`` answers transient-crash queries exactly as the
+  real engine asks them;
+- straggler slowdowns inflate the *realized* per-batch time fed back
+  through ``note_realized_per_batch`` and ``health.note_step`` — so EWMA
+  correction, straggler detection and degrade replans all run on the
+  production code paths, driven by simulated observations.
+
+No threads, no techniques, no sleeps: an interval's execution is a pure
+function of (plan, health, faults) and completes instantly in wall time
+while representing ``interval`` seconds of simulated time.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+# Re-exported real arithmetic — the twin must never fork this math.
+from saturn_tpu.executor.engine import forecast, rollback_forecast  # noqa: F401
+from saturn_tpu.resilience.faults import FaultKind, PreemptedError
+
+
+class VirtualEngine:
+    """Drop-in for the service loop's ``engine.execute`` call."""
+
+    def __init__(self, health=None, faults=None):
+        self.health = health
+        self.faults = faults
+
+    def execute(
+        self,
+        run_tasks,
+        batches: Dict[str, int],
+        interval: float,
+        plan,
+        topo,
+        *,
+        interval_index: int = 0,
+        on_task_start: Optional[Callable[[str], None]] = None,
+        on_task_done: Optional[Callable[[str, int], None]] = None,
+        **_ignored,
+    ) -> Dict[str, Exception]:
+        """Simulate one interval; returns ``{task_name: error}`` exactly like
+        the real engine (empty dict = everything retired cleanly).
+
+        Tasks are visited in (planned start, name) order — a deterministic
+        serialization of the gang schedule. ``on_task_done`` fires only for
+        tasks that retired their full budget, matching the real engine's
+        all-or-nothing interval contract.
+        """
+        health, faults = self.health, self.faults
+        errors: Dict[str, Exception] = {}
+        # Mid-interval chaos fires before any work retires (see module doc).
+        if faults is not None and health is not None:
+            faults.apply_due(interval_index, health, mid_interval=True)
+        for task in sorted(
+            run_tasks, key=lambda t: (plan.assignments[t.name].start, t.name)
+        ):
+            a = plan.assignments[task.name]
+            if on_task_start is not None:
+                on_task_start(task.name)
+            if faults is not None and faults.crashes(task.name, interval_index):
+                errors[task.name] = RuntimeError(
+                    f"injected transient crash: {task.name} "
+                    f"interval {interval_index}"
+                )
+                continue
+            idx = []
+            if health is not None:
+                idx = health.indices_of(topo.block_devices(a.block))
+                if idx and health.any_lost(idx):
+                    errors[task.name] = PreemptedError(
+                        f"{task.name}: block {a.block} lost a device during "
+                        f"interval {interval_index}"
+                    )
+                    continue
+            strat = task.strategies[a.apportionment]
+            slow = health.max_slowdown(idx) if (health and idx) else 1.0
+            realized = strat.per_batch_time * slow
+            task.select_strategy(a.apportionment)
+            note = getattr(task, "note_realized_per_batch", None)
+            if note is not None:
+                note(realized)
+            if health is not None and idx:
+                # The monitor folds injected slowdowns itself — feed the
+                # nominal time, as a real technique's timer would.
+                health.note_step(idx, strat.per_batch_time)
+            if on_task_done is not None:
+                on_task_done(task.name, batches.get(task.name, 0))
+        return errors

@@ -1,0 +1,198 @@
+"""Virtual fleets: the accelerator pool the twin schedules, minus the chips.
+
+A :class:`VirtualFleet` is N identical slices of M virtual devices each,
+arranged slice-major into the same :class:`~saturn_tpu.core.mesh.
+SliceTopology` the real service binds — so block alignment, slice-crossing
+(DCN) penalties and capacity arithmetic are exactly the production code
+paths. Devices are inert descriptor objects (no jax, no memory_stats), so
+memlens sees "capacity unknown" and the twin's own oracle
+(:mod:`saturn_tpu.twin.oracle`) is the memory gate instead.
+
+Failure processes come in two flavors, both seeded and deterministic:
+
+- :meth:`VirtualFleet.failure_schedule` — per-slice Bernoulli preemption
+  renewal processes (each live slice is reclaimed with ``p_preempt`` per
+  interval and returns ``outage_intervals`` later), the spot-fleet shape.
+- :meth:`VirtualFleet.storm_schedule` — the generic chaos generator
+  (``resilience.faults.seeded_schedule``: block preemptions, stragglers,
+  transient crashes) *sanitized* so the fleet never loses its last live
+  slice — a zero-capacity mesh has no plan to verify, and real reclaim
+  systems likewise never take the final slice of a reservation.
+
+Both return plain ``FaultEvent`` lists for ``resilience.faults.
+FaultInjector`` — the same injector/monitor machinery the real
+orchestrator uses.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.resilience.faults import FaultEvent, FaultKind, seeded_schedule
+
+
+@dataclass(frozen=True)
+class VirtualDevice:
+    """Inert device descriptor: satisfies every ``getattr``-probing consumer
+    (mesh binding, health monitor identity maps) without any runtime."""
+
+    index: int              # global device index (slice-major)
+    slice_id: int
+    hbm_bytes: int
+    platform: str = "twin"
+    device_kind: str = "virtual-tpu"
+
+    @property
+    def process_index(self) -> int:
+        return self.slice_id  # one virtual host per slice
+
+    def __repr__(self) -> str:
+        return f"VirtualDevice(d{self.index}/s{self.slice_id})"
+
+
+@dataclass(frozen=True)
+class SliceSpec:
+    """Shape + failure parameters for one (or every) virtual slice."""
+
+    chips: int = 8
+    hbm_gib: float = 16.0
+    ici_gbps: float = 1200.0      # intra-slice interconnect (descriptive)
+    dcn_gbps: float = 25.0        # cross-slice fabric (descriptive)
+    p_preempt: float = 0.0        # per-interval whole-slice reclaim prob.
+    outage_intervals: int = 2     # intervals until a reclaimed slice returns
+
+
+class VirtualFleet:
+    """``n_slices`` virtual slices sharing one :class:`SliceSpec` shape.
+
+    Slice chip counts must be uniform (that is what ``SliceTopology``'s
+    explicit ``slice_size`` encodes); HBM and failure parameters may vary
+    per slice via ``overrides``.
+    """
+
+    def __init__(self, n_slices: int = 4, spec: SliceSpec = SliceSpec(),
+                 overrides: Optional[Dict[int, SliceSpec]] = None):
+        if n_slices < 1:
+            raise ValueError(f"need at least one slice, got {n_slices}")
+        self.spec = spec
+        self.specs: List[SliceSpec] = [
+            (overrides or {}).get(s, spec) for s in range(n_slices)
+        ]
+        if any(sp.chips != spec.chips for sp in self.specs):
+            raise ValueError(
+                "slice chip counts must be uniform (SliceTopology encodes "
+                "one slice_size); vary HBM/failure params instead"
+            )
+        self.n_slices = n_slices
+        self.chips = spec.chips
+        self.devices: List[VirtualDevice] = []
+        for s, sp in enumerate(self.specs):
+            hbm = int(sp.hbm_gib * (1 << 30))
+            for c in range(sp.chips):
+                self.devices.append(
+                    VirtualDevice(index=s * sp.chips + c, slice_id=s,
+                                  hbm_bytes=hbm)
+                )
+
+    # ------------------------------------------------------------- topology
+    def topology(self) -> SliceTopology:
+        return SliceTopology(list(self.devices), slice_size=self.chips)
+
+    def slice_indices(self, slice_id: int) -> Tuple[int, ...]:
+        if not 0 <= slice_id < self.n_slices:
+            raise IndexError(f"no slice {slice_id} in a {self.n_slices}-slice fleet")
+        base = slice_id * self.chips
+        return tuple(range(base, base + self.chips))
+
+    def describe(self) -> dict:
+        return {
+            "n_slices": self.n_slices,
+            "chips_per_slice": self.chips,
+            "n_devices": len(self.devices),
+            "hbm_gib_per_chip": self.spec.hbm_gib,
+            "ici_gbps": self.spec.ici_gbps,
+            "dcn_gbps": self.spec.dcn_gbps,
+        }
+
+    # ------------------------------------------------------------- failures
+    def failure_schedule(self, seed: int, n_intervals: int) -> List[FaultEvent]:
+        """Per-slice seeded preemption renewal process.
+
+        Each interval, every *live* slice is independently reclaimed with
+        its spec's ``p_preempt``; a reclaimed slice returns whole after
+        ``outage_intervals``. The last live slice is never taken (see
+        module docstring). RNG draws happen in (interval, slice) order, so
+        the schedule is a pure function of (seed, n_intervals, specs).
+        """
+        rng = random.Random(seed)
+        events: List[FaultEvent] = []
+        down_until = [0] * self.n_slices   # interval index the slice returns
+        for i in range(n_intervals):
+            for s, sp in enumerate(self.specs):
+                if i < down_until[s]:
+                    continue
+                if sp.p_preempt <= 0.0 or rng.random() >= sp.p_preempt:
+                    continue
+                live_others = sum(
+                    1 for o in range(self.n_slices)
+                    if o != s and i >= down_until[o]
+                )
+                if live_others == 0:
+                    continue  # never empty the fleet
+                devs = self.slice_indices(s)
+                events.append(FaultEvent(
+                    i, FaultKind.SLICE_PREEMPTION, devices=devs,
+                    after_s=0.001,  # mid-interval: running work is lost
+                ))
+                back = i + max(1, sp.outage_intervals)
+                down_until[s] = back
+                events.append(FaultEvent(
+                    back, FaultKind.DEVICE_RETURN, devices=devs,
+                ))
+        return events
+
+    def storm_schedule(self, seed: int, n_intervals: int, *,
+                       p_preempt: float = 0.15, p_crash: float = 0.1,
+                       p_straggler: float = 0.05,
+                       outage_intervals: int = 2) -> List[FaultEvent]:
+        """Chaos storm: ``resilience.faults.seeded_schedule`` over the whole
+        fleet, sanitized for a long-running campaign.
+
+        The raw generator emits block preemptions with no matching returns
+        and no floor on surviving capacity. Here every preemption gets a
+        ``DEVICE_RETURN`` ``outage_intervals`` later, and a preemption that
+        would leave fewer than one full slice of live devices is dropped —
+        the fleet always retains schedulable capacity.
+        """
+        raw = seeded_schedule(
+            seed, n_intervals, len(self.devices),
+            p_preempt=p_preempt, p_crash=p_crash, p_straggler=p_straggler,
+        )
+        events: List[FaultEvent] = []
+        down: Dict[int, int] = {}   # device index -> return interval
+        for ev in sorted(raw, key=lambda e: (e.at_interval, e.after_s, e.kind)):
+            if ev.kind != FaultKind.SLICE_PREEMPTION:
+                events.append(ev)
+                continue
+            i = ev.at_interval
+            for d, back in list(down.items()):
+                if back <= i:
+                    del down[d]
+            taking = [d for d in ev.devices if d not in down]
+            survivors = len(self.devices) - len(down) - len(taking)
+            if not taking or survivors < self.chips:
+                continue  # keep at least one slice's worth of capacity
+            events.append(FaultEvent(
+                i, FaultKind.SLICE_PREEMPTION, devices=tuple(taking),
+                after_s=ev.after_s,
+            ))
+            back = i + max(1, outage_intervals)
+            events.append(FaultEvent(
+                back, FaultKind.DEVICE_RETURN, devices=tuple(taking),
+            ))
+            for d in taking:
+                down[d] = back
+        return events
