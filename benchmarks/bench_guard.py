@@ -743,6 +743,205 @@ def validate_twin_row(row) -> list:
     return problems
 
 
+#: Pinned-seed twin regression campaign: the standing scheduling-policy
+#: guard (ROADMAP item 3 headroom). A small tenant-tagged mix runs the real
+#: control plane (gateway window, admission controller, anytime solver tier
+#: ladder) on virtual slices in under a second; its tier shares, verdict
+#: shares and simulated makespan are pinned here with bands. A scheduling-
+#: policy change that shifts which solver tier wins, flips admission
+#: verdicts, or moves the campaign makespan outside the band fails the
+#: guard — BEFORE it gets to record a new headline baseline. Values pinned
+#: from the seeded run (deterministic: simulated clock, seeded arrivals).
+TWIN_REGRESSION = {
+    "seed": 23,
+    "n_jobs": 600,
+    "n_slices": 4,
+    "tenant_mix": {"burst": 10.0, "quiet-a": 1.0, "quiet-b": 1.0},
+    "tier_shares": {"1": 0.5, "2": 0.5},
+    "tier_band": 0.15,           # absolute share drift allowed per tier
+    "verdict_shares": {"admit": 1.0},
+    "verdict_band": 0.10,        # absolute share drift allowed per verdict
+    "makespan_s": 1200.22,
+    "makespan_tol": 0.20,        # +/- fraction
+}
+
+
+def twin_regression_errors() -> list:
+    """Run the pinned-seed twin campaign and compare against the recorded
+    band. Returns human-readable problems (empty list = in band).
+
+    The campaign drives the REAL admission controller and solver over a
+    simulated fleet, so this is the cheapest end-to-end check that a
+    scheduling-policy change kept its distributional behavior: same tier
+    adoption, same verdict mix, same makespan — and the tenant-tagged
+    arrival mix keeps the fair-share path on the measured surface.
+    """
+    import shutil
+    import tempfile
+
+    sys.path.insert(0, REPO)
+    from saturn_tpu.twin.runner import CampaignConfig, run_campaign
+
+    pin = TWIN_REGRESSION
+    out_dir = tempfile.mkdtemp(prefix="twin_regression_")
+    try:
+        cfg = CampaignConfig(
+            n_jobs=pin["n_jobs"], n_slices=pin["n_slices"],
+            chips_per_slice=8, interval_s=600.0, solve_deadline_s=5.0,
+            base_rate_hz=4.0, burst_rate_hz=12.0, total_batches=3,
+            max_inflight=2_000, metrics=False, compact_every=8,
+            seed=pin["seed"], max_intervals=200,
+            tenant_mix=dict(pin["tenant_mix"]),
+        )
+        s = run_campaign(cfg, out_dir)
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    problems = []
+    if s.get("status") != "ok":
+        problems.append(f"campaign status {s.get('status')!r}, expected 'ok'")
+    if s.get("deadline_misses"):
+        problems.append(
+            f"{s['deadline_misses']} solver deadline miss(es) in a campaign "
+            "shape that historically has zero"
+        )
+    got_tiers = {str(k): v for k, v in (s.get("tier_shares") or {}).items()}
+    for tier in set(pin["tier_shares"]) | set(got_tiers):
+        want = pin["tier_shares"].get(tier, 0.0)
+        got = got_tiers.get(tier, 0.0)
+        if abs(got - want) > pin["tier_band"]:
+            problems.append(
+                f"tier {tier} share {got:.3f} outside pinned "
+                f"{want:.3f} +/- {pin['tier_band']}"
+            )
+    got_verdicts = dict(s.get("verdict_shares") or {})
+    for verdict in set(pin["verdict_shares"]) | set(got_verdicts):
+        want = pin["verdict_shares"].get(verdict, 0.0)
+        got = got_verdicts.get(verdict, 0.0)
+        if abs(got - want) > pin["verdict_band"]:
+            problems.append(
+                f"verdict {verdict!r} share {got:.3f} outside pinned "
+                f"{want:.3f} +/- {pin['verdict_band']}"
+            )
+    mk = s.get("makespan_s")
+    if isinstance(mk, (int, float)) and not isinstance(mk, bool):
+        lo = pin["makespan_s"] * (1.0 - pin["makespan_tol"])
+        hi = pin["makespan_s"] * (1.0 + pin["makespan_tol"])
+        if not lo <= mk <= hi:
+            problems.append(
+                f"makespan_sim {mk:.1f}s outside pinned "
+                f"[{lo:.1f}, {hi:.1f}]s"
+            )
+    else:
+        problems.append(f"campaign makespan_s missing/bad: {mk!r}")
+    # The tenant mix must actually skew: the fair-share surface is only
+    # exercised when the noisy neighbour dominates the arrival stream.
+    sub = s.get("tenant_submitted") or {}
+    bursty = sub.get("burst", 0)
+    quiet = [v for k, v in sub.items() if k != "burst"]
+    if not quiet or any(bursty < 4 * q for q in quiet):
+        problems.append(
+            f"tenant mix lost its burst skew: {sub!r} (burst must "
+            "dominate every quiet tenant at least 4:1)"
+        )
+    return problems
+
+
+#: Required key -> type for the ``benchmarks/tenant_fairshare.py`` row.
+#: Same contract as the other ROW_REQUIRED tables: the bench self-validates
+#: before printing, and recorded rows can be re-checked without re-running.
+TENANT_ROW_REQUIRED = {
+    "metric": str,                # "tenant_fairshare"
+    "n_tenants": int,             # >= 3
+    "n_jobs": int,                # contended-phase arrivals
+    "burst_skew": float,          # bursty:quiet arrival-weight ratio, >= 10
+    "bursty_tenant": str,
+    "submitted": dict,            # tenant -> submit attempts
+    "admitted": dict,             # tenant -> accepted admissions
+    "shed": dict,                 # tenant -> gateway sheds
+    "solo_p99_s": float,          # quiet tenant alone on the gateway
+    "quiet_p99_s": float,         # quiet tenants under the burst
+    "p99_ratio": float,           # quiet_p99 / solo_p99, must stay <= 2
+    "warm_hit_rate": float,       # compile-ahead warm phase, must be >= .8
+    "first_dispatch_wait_s": float,  # mean compile wait at first dispatch
+    "wall_s": float,
+    "seed": int,
+    "status": str,
+}
+
+#: Acceptance bars for the tenant row (shared with the bench so the
+#: self-validation and any later re-check apply identical thresholds).
+TENANT_MIN_TENANTS = 3
+TENANT_MIN_SKEW = 10.0
+TENANT_P99_RATIO_MAX = 2.0
+TENANT_WARM_HIT_MIN = 0.8
+
+
+def validate_tenant_row(row) -> list:
+    """Schema-check one tenant-fairness row; returns human-readable
+    problems (empty list = valid).
+
+    Enforces the fairness acceptance bars: >= 3 tenants at >= 10:1 burst
+    skew, the bursty tenant sheds while every quiet tenant sheds NOTHING,
+    quiet-tenant p99 admission latency within 2x its solo baseline, and a
+    compile-ahead warm hit rate of at least 80%."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in TENANT_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "tenant_fairshare":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'tenant_fairshare'"
+        )
+    nt = row.get("n_tenants")
+    if isinstance(nt, int) and not isinstance(nt, bool) \
+            and nt < TENANT_MIN_TENANTS:
+        problems.append(f"n_tenants {nt} < {TENANT_MIN_TENANTS}")
+    skew = row.get("burst_skew")
+    if isinstance(skew, (int, float)) and not isinstance(skew, bool) \
+            and skew < TENANT_MIN_SKEW:
+        problems.append(f"burst_skew {skew} < {TENANT_MIN_SKEW}")
+    bursty = row.get("bursty_tenant")
+    shed = row.get("shed")
+    if isinstance(shed, dict) and isinstance(bursty, str):
+        if not shed.get(bursty):
+            problems.append(
+                f"bursty tenant {bursty!r} shed nothing — the quota/"
+                "pressure path was not exercised"
+            )
+        quiet_shed = {t: n for t, n in shed.items() if t != bursty and n}
+        if quiet_shed:
+            problems.append(
+                f"quiet tenant(s) shed work under the burst: {quiet_shed!r}"
+            )
+    ratio = row.get("p99_ratio")
+    if isinstance(ratio, (int, float)) and not isinstance(ratio, bool) \
+            and ratio > TENANT_P99_RATIO_MAX:
+        problems.append(
+            f"quiet-tenant p99 ratio {ratio} > {TENANT_P99_RATIO_MAX}x "
+            "solo baseline (the burst degraded the quiet tenants)"
+        )
+    hr = row.get("warm_hit_rate")
+    if isinstance(hr, (int, float)) and not isinstance(hr, bool) \
+            and hr < TENANT_WARM_HIT_MIN:
+        problems.append(
+            f"warm_hit_rate {hr} < {TENANT_WARM_HIT_MIN} (compile-ahead "
+            "missed on jobs it was told about at admission)"
+        )
+    return problems
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (
@@ -821,6 +1020,20 @@ def main() -> int:
         print(json.dumps({
             "metric": "bench_guard", "status": "memlens_findings",
             "value": new.get("value"), "diagnostics": ml_errors,
+        }))
+        return 1
+    try:
+        tw_errors = twin_regression_errors()
+    except Exception as e:
+        tw_errors = [f"twin regression campaign unavailable: "
+                     f"{type(e).__name__}: {e}"]
+    if tw_errors:
+        # Same refusal for the scheduling policy: the row was measured by a
+        # control plane whose tier/verdict/makespan distributions drifted
+        # out of the pinned twin band.
+        print(json.dumps({
+            "metric": "bench_guard", "status": "twin_regression",
+            "value": new.get("value"), "diagnostics": tw_errors,
         }))
         return 1
     out = {

@@ -18,6 +18,11 @@ Subcommands:
   — the durable dedup table (idempotent submission keys -> job ids), retry
   collapses, the shed ledger by reason, and drain markers (a missing or
   dirty marker means the last incarnation died instead of handing off).
+- ``tenancy DIR``: operator view of a durability journal's multi-tenant
+  records — per-tenant admission verdicts, gateway sheds, chip-second
+  burn vs budget, the gateway lease/epoch history (replica failovers),
+  and the compile-ahead hit/miss ledger.  Exit 1 on lease fencing
+  violations (an epoch issued twice, or to two owners).
 - ``concurrency [PATH ...]``: saturn-tsan's static pass over the thread
   mesh — lock-order inversions, unguarded shared state, blocking calls
   under a lock, condition-wait-without-loop (SAT-C001..C004).  With no
@@ -262,6 +267,124 @@ def _cmd_gateway(args: argparse.Namespace) -> int:
     if not drains:
         print("no drain marker: the last gateway incarnation did not "
               "hand off cleanly (crashed or still running)")
+    return 0
+
+
+def _cmd_tenancy(args: argparse.Namespace) -> int:
+    from saturn_tpu.durability import journal as jmod
+
+    try:
+        records = list(jmod.replay(args.path))
+    except OSError as e:
+        print(f"cannot replay journal at {args.path!r}: {e}",
+              file=sys.stderr)
+        return 2
+
+    tenants: dict = {}   # tenant -> {admit/defer/reject, sheds, charged}
+
+    def row(tenant) -> dict:
+        t = tenant if tenant else "default"
+        return tenants.setdefault(t, {
+            "submitted": 0, "admit": 0, "defer": 0, "reject": 0,
+            "sheds": {}, "charged_chip_s": 0.0,
+        })
+
+    leases: list = []    # (epoch, owner, prev_owner) in journal order
+    compile_counts: dict = {}
+    for rec in records:
+        kind, d = rec["kind"], rec.get("data", {})
+        if kind == "job_submitted":
+            row(d.get("tenant"))["submitted"] += 1
+        elif kind == "job_admission":
+            r = row(d.get("tenant"))
+            dec = d.get("decision", "?")
+            if dec in r:
+                r[dec] += 1
+        elif kind == "gateway_shed":
+            r = row(d.get("tenant"))
+            reason = d.get("reason", "unknown")
+            r["sheds"][reason] = r["sheds"].get(reason, 0) + 1
+        elif kind == "tenant_charge":
+            row(d.get("tenant"))["charged_chip_s"] += float(
+                d.get("chip_s", 0.0))
+        elif kind == "gateway_lease":
+            leases.append((int(d.get("epoch", 0)), d.get("owner"),
+                           d.get("prev_owner")))
+        elif kind == "compile_ahead":
+            status = d.get("status", "?")
+            compile_counts[status] = compile_counts.get(status, 0) + 1
+
+    # Fencing audit: every epoch is minted exactly once, under the lease
+    # lock, so a value appearing in two records (or bound to two owners)
+    # means a deposed replica kept acting on a fenced epoch. Record
+    # *order* is not audited — lease records are journaled outside the
+    # lease lock and may legitimately land out of order.
+    violations: list = []
+    seen: dict = {}
+    for epoch, owner, _prev in leases:
+        if epoch in seen:
+            violations.append(
+                f"epoch {epoch} issued twice "
+                f"(to {seen[epoch]!r} and {owner!r})"
+            )
+        else:
+            seen[epoch] = owner
+    current_epoch = max(seen) if seen else 0
+
+    hits = compile_counts.get("hit", 0)
+    misses = compile_counts.get("miss", 0)
+    hit_rate = (round(hits / (hits + misses), 6)
+                if (hits + misses) > 0 else None)
+    for r in tenants.values():
+        r["charged_chip_s"] = round(r["charged_chip_s"], 6)
+    payload = {
+        "tenants": {t: tenants[t] for t in sorted(tenants)},
+        "lease": {
+            "records": len(leases),
+            "current_epoch": current_epoch,
+            "current_owner": seen.get(current_epoch),
+            "history": [
+                {"epoch": e, "owner": o, "prev_owner": p}
+                for e, o, p in sorted(leases)
+            ],
+        },
+        "compile_ahead": dict(sorted(compile_counts.items())),
+        "compile_ahead_hit_rate": hit_rate,
+        "fencing_violations": violations,
+    }
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+        return 1 if violations else 0
+    if not (tenants or leases or compile_counts):
+        print(f"{args.path}: no tenancy records in the durable journal")
+        return 0
+    for t in sorted(tenants):
+        r = tenants[t]
+        bits = [f"{r['submitted']} submitted",
+                f"admit {r['admit']} / defer {r['defer']} / "
+                f"reject {r['reject']}"]
+        if r["sheds"]:
+            bits.append("sheds " + ", ".join(
+                f"{k}x{n}" for k, n in sorted(r["sheds"].items())))
+        if r["charged_chip_s"]:
+            bits.append(f"burned {r['charged_chip_s']:g} chip-s")
+        print(f"{t}: " + "; ".join(bits))
+    if leases:
+        print(f"lease: epoch {current_epoch} held by "
+              f"{seen.get(current_epoch)!r} "
+              f"({len(leases)} transition(s))")
+        for e, o, p in sorted(leases):
+            print(f"  epoch {e}: {p!r} -> {o!r}")
+    if compile_counts:
+        rate = f"{100 * hit_rate:.1f}%" if hit_rate is not None else "n/a"
+        print("compile-ahead: " + ", ".join(
+            f"{k}x{n}" for k, n in sorted(compile_counts.items()))
+            + f"; first-dispatch hit rate {rate}")
+    if violations:
+        print("LEASE FENCING VIOLATIONS:")
+        for v in violations:
+            print(f"  {v}")
+        return 1
     return 0
 
 
@@ -860,6 +983,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     g.add_argument("path")
     g.set_defaults(fn=_cmd_gateway)
+
+    tn = sub.add_parser(
+        "tenancy",
+        help="summarize journaled multi-tenant records: per-tenant "
+             "admit/shed/burn ledger, lease/epoch history, compile-ahead "
+             "hit rate (exit 1 on lease fencing violations)",
+    )
+    tn.add_argument("path")
+    tn.set_defaults(fn=_cmd_tenancy)
 
     c = sub.add_parser(
         "concurrency",

@@ -1343,6 +1343,7 @@ AUDITED_PATHS: Tuple[str, ...] = (
     "saturn_tpu/durability",
     "saturn_tpu/data",
     "saturn_tpu/health",
+    "saturn_tpu/tenancy",
     "saturn_tpu/utils/metrics.py",
 )
 

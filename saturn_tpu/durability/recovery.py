@@ -49,6 +49,7 @@ class JobReplay:
     error: Optional[str] = None
     spec: Optional[dict] = None    # caller-supplied rebuild spec
     dedup_key: Optional[str] = None  # gateway idempotency key (if any)
+    tenant: Optional[str] = None   # billing/fairness principal (if any)
 
     @property
     def terminal(self) -> bool:
@@ -80,6 +81,20 @@ class ServiceRecovery:
     #: submit whose ACK died with the previous incarnation gets the
     #: original job id back, exactly-once across restarts.
     dedup: Dict[str, str] = field(default_factory=dict)
+    #: Replica-lease fencing state, folded from ``gateway_lease`` records:
+    #: the max journaled epoch (and its owner). A restarted control plane
+    #: seeds its ReplicaLease from this so fenced epochs are never reused.
+    lease_epoch: int = 0
+    lease_owner: Optional[str] = None
+    #: Full journaled acquisition history [(epoch, owner, prev_owner)] in
+    #: replay order — the operator CLI's failover audit trail.
+    lease_history: List[Any] = field(default_factory=list)
+    #: tenant -> cumulative chip-seconds burned, folded from
+    #: ``tenant_charge`` records; TenantLedger.restore() re-seats budgets.
+    tenant_charges: Dict[str, float] = field(default_factory=dict)
+    #: compile_ahead event status -> count (requested/ready/error/hit/miss)
+    #: — the durable half of the compile-ahead hit/miss ledger.
+    compile_ahead: Dict[str, int] = field(default_factory=dict)
 
     def live_jobs(self) -> List[JobReplay]:
         return [j for j in self.jobs.values() if not j.terminal]
@@ -166,6 +181,7 @@ def replay_service_state(root: str) -> ServiceRecovery:
                 total_batches=int(d.get("total_batches") or 0),
                 spec=d.get("spec"),
                 dedup_key=d.get("dedup_key"),
+                tenant=d.get("tenant"),
             )
             if d.get("dedup_key") is not None:
                 state.dedup[d["dedup_key"]] = d["job"]
@@ -192,6 +208,26 @@ def replay_service_state(root: str) -> ServiceRecovery:
         elif kind == "ckpt_published":
             task = d.get("task") or d.get("path", "")
             state.checkpoints.setdefault(task, []).append(d.get("path", ""))
+        elif kind == "gateway_lease":
+            epoch = int(d.get("epoch", 0))
+            owner = d.get("owner")
+            state.lease_history.append(
+                (epoch, owner, d.get("prev_owner"))
+            )
+            # Max, not last: two replicas racing a takeover may journal out
+            # of order (the record is written outside the lease lock), and
+            # only the highest epoch ever fences anything.
+            if epoch > state.lease_epoch:
+                state.lease_epoch = epoch
+                state.lease_owner = owner
+        elif kind == "tenant_charge":
+            t = d.get("tenant") or "default"
+            state.tenant_charges[t] = (
+                state.tenant_charges.get(t, 0.0) + float(d.get("chip_s", 0.0))
+            )
+        elif kind == "compile_ahead":
+            s = d.get("status", "unknown")
+            state.compile_ahead[s] = state.compile_ahead.get(s, 0) + 1
         else:
             fold_health_record(kind, d, state.quarantined, state.detached)
     return state
@@ -323,7 +359,7 @@ def build_restore_records(
                 task=RecoveredTaskStub(j.task, j.total_batches),
                 priority=j.priority, deadline_s=j.deadline_s,
                 max_retries=j.max_retries, spec=j.spec,
-                dedup_key=j.dedup_key,
+                dedup_key=j.dedup_key, tenant=j.tenant,
             )
             rec = JobRecord(
                 job_id=j.job_id, request=req,
@@ -342,6 +378,7 @@ def build_restore_records(
             "deadline_s": j.deadline_s,
             "max_retries": j.max_retries,
             "spec": j.spec,
+            "tenant": j.tenant,
         })
         if getattr(task, "name", None) != j.task:
             raise ValueError(
@@ -355,7 +392,7 @@ def build_restore_records(
         req = JobRequest(
             task=task, priority=j.priority, deadline_s=j.deadline_s,
             max_retries=j.max_retries, spec=j.spec,
-            dedup_key=j.dedup_key,
+            dedup_key=j.dedup_key, tenant=j.tenant,
         )
         rec = JobRecord(
             job_id=j.job_id, request=req, state=JobState.QUEUED,

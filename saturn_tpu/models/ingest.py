@@ -46,6 +46,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from saturn_tpu.analysis import concurrency as _tsan
+
 __all__ = [
     "load_torch_state_dict",
     "params_from_state_dict",
@@ -56,13 +58,25 @@ __all__ = [
 
 _cache_key: Optional[tuple] = None
 _cache_val: Optional[tuple] = None
+# Guards the size-1 cache above: parallel trial sweeps build ModelSpecs
+# from worker threads, and an unsynchronized lookup/load/store interleave
+# can both double-load a multi-GB checkpoint and publish a half-written
+# (key, val) pair (key from one thread, val from another).
+_cache_lock = _tsan.lock("ingest.params_cache")
 
 
 def cached_params_from_path(path: str, cfg: Any, **kw):
     """Load + map ``path`` once per (file, preset shape) — strategy search
     builds one ModelSpec per candidate config (``spmd_base._build_uncached``),
     and re-reading a multi-GB checkpoint per config would dominate the sweep.
-    Size-1 cache: a 6B mapped tree is ~24 GB of host RAM; never hold two."""
+    Size-1 cache: a 6B mapped tree is ~24 GB of host RAM; never hold two.
+
+    Thread-safe: lookup, load, and store all happen under
+    ``ingest.params_cache`` — concurrent callers with the same key share
+    one load, and a (key, val) pair is only ever published whole. The
+    multi-GB torch load stays under the lock deliberately: two concurrent
+    loads would blow host RAM, which is worse than serializing them.
+    """
     global _cache_key, _cache_val
     import os
 
@@ -71,12 +85,13 @@ def cached_params_from_path(path: str, cfg: Any, **kw):
         cfg.d_model, cfg.vocab_size, cfg.seq_len, cfg.rotary,
         tuple(sorted(kw.items())),
     )
-    if _cache_key == key and _cache_val is not None:
+    with _cache_lock:
+        if _cache_key == key and _cache_val is not None:
+            return _cache_val
+        mapped, unused = params_from_state_dict(load_torch_state_dict(path),
+                                                cfg, **kw)
+        _cache_key, _cache_val = key, (mapped, unused)
         return _cache_val
-    mapped, unused = params_from_state_dict(load_torch_state_dict(path),
-                                            cfg, **kw)
-    _cache_key, _cache_val = key, (mapped, unused)
-    return _cache_val
 
 
 def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:

@@ -20,6 +20,14 @@ Weights: each admitted job gets a solver-objective weight
 with a deadline-urgency boost capped at 2x (a job whose estimated runtime
 already consumes its slack is maximally urgent). ``solver.milp`` folds the
 normalized weights into the objective as a weighted-start-time tiebreak.
+
+Tenancy (when the service wires a ``TenantLedger``): before any profiling
+spend, the arrival's tenant is gated on its quota — over ``max_live_jobs``
+DEFERs (the tenant's own completions free the slot), an exhausted
+``chip_seconds`` budget REJECTs — and an admitted job's weight is scaled
+by the tenant's weighted-fair-share multiplier, so an over-share tenant's
+new work yields the solver's attention to under-share tenants without
+overriding priority classes or deadlines.
 """
 
 from __future__ import annotations
@@ -100,6 +108,21 @@ class AdmissionController:
         #: durability is on): every admission outcome becomes a buffered
         #: ``job_admission`` record, durable at the next group commit.
         self.journal = None
+        #: Optional TenantLedger (set by ``SaturnService`` when tenancy is
+        #: on): quota gates + fair-share weight scaling, see module doc.
+        self.tenancy = None
+        #: tenant -> jobs ADMITted in the *current* drain pass. The queue
+        #: only counts a job as admitted once the post-solve SCHEDULED mark
+        #: lands, so without this a burst draining in one pass would sail
+        #: past ``max_live_jobs`` together. The server resets it via
+        #: :meth:`begin_pass` before each drain.
+        self._pass_admitted: dict = {}
+
+    def begin_pass(self) -> None:
+        """Start a new drain pass (resets the in-pass admission tally)."""
+        # sanctioned-unlocked: drain-pass scratch owned by the scheduler
+        # thread (see admit); cleared here before each drain.
+        self._pass_admitted.clear()
 
     def admit(self, rec: JobRecord, topology: SliceTopology) -> AdmissionDecision:
         """Profile (if needed) and decide one arrival.
@@ -111,6 +134,14 @@ class AdmissionController:
         t0 = timeit.default_timer()
         self.queue.mark(rec, JobState.PROFILING)
         task = rec.task
+
+        # Tenant quota gate: before a single trial or compile is spent on
+        # this arrival. Both verdicts are cheap ledger lookups.
+        if self.tenancy is not None:
+            dec = self._tenant_gate(rec, t0)
+            if dec is not None:
+                self._note(rec, dec)
+                return dec
 
         # Memlens cold-start memory gate: before any trial or compile, the
         # static liveness analysis checks every fitting (technique, size,
@@ -215,6 +246,12 @@ class AdmissionController:
         weight = compute_weight(
             rec.request.priority, slack, _min_feasible_runtime(task)
         )
+        if self.tenancy is not None:
+            # Weighted fair share: scale (never override) the priority/
+            # deadline weight by how far the tenant sits from its slice.
+            weight *= self.tenancy.fair_share_multiplier(
+                rec.tenant, self.queue.live_by_tenant()
+            )
         rec.weight = weight
         # Scheduling-only hints: the replanner's eviction policies order by
         # task.hints["priority"]; profile_cache.task_signature excludes both
@@ -230,8 +267,53 @@ class AdmissionController:
             latency_s=timeit.default_timer() - t0,
             static_prior=used_prior,
         )
+        if self.tenancy is not None:
+            self.tenancy.note_admit(rec.tenant)
+            # sanctioned-unlocked: _pass_admitted is drain-pass scratch,
+            # touched only by the single scheduler thread that calls
+            # begin_pass()/admit() back-to-back — no concurrent access.
+            self._pass_admitted[rec.tenant] = (
+                self._pass_admitted.get(rec.tenant, 0) + 1
+            )
         self._note(rec, dec)
         return dec
+
+    # -------------------------------------------------------------- tenancy
+    def _tenant_gate(self, rec: JobRecord, t0: float):
+        """Quota verdict for the arrival's tenant, or None to proceed.
+
+        Chip-seconds exhaustion is terminal (REJECT: the budget never
+        refills by waiting); a full ``max_live_jobs`` window DEFERs — the
+        tenant's own completions free slots, and the requeue re-admits
+        warm. The gate counts *admitted* (SCHEDULED/RUNNING) jobs, not
+        queued arrivals: counting a burst's own queued siblings would
+        defer the whole burst forever.
+        """
+        tenant = rec.tenant
+        quota = self.tenancy.quota(tenant)
+        if self.tenancy.budget_exhausted(tenant):
+            return AdmissionDecision(
+                REJECT,
+                reason=(
+                    f"tenant {tenant!r} chip-seconds budget exhausted "
+                    f"({self.tenancy.charged(tenant):.1f}s burned of "
+                    f"{quota.chip_seconds:.1f}s)"
+                ),
+                latency_s=timeit.default_timer() - t0,
+            )
+        if quota.max_live_jobs is not None:
+            admitted = (self.queue.admitted_tenant(tenant)
+                        + self._pass_admitted.get(tenant, 0))
+            if admitted >= quota.max_live_jobs:
+                return AdmissionDecision(
+                    DEFER,
+                    reason=(
+                        f"tenant {tenant!r} has {admitted} admitted job(s), "
+                        f"at its max_live_jobs quota {quota.max_live_jobs}"
+                    ),
+                    latency_s=timeit.default_timer() - t0,
+                )
+        return None
 
     # -------------------------------------------------------------- memlens
     def _memlens_verdict(self, task, topology: SliceTopology):
@@ -299,7 +381,7 @@ class AdmissionController:
                 "job_admission", job=rec.job_id, task=rec.name,
                 decision=dec.action, reason=dec.reason,
                 trials_run=dec.trials_run, weight=round(dec.weight, 6),
-                static_prior=dec.static_prior,
+                static_prior=dec.static_prior, tenant=rec.tenant,
             )
         metrics.event(
             "job_admitted", job=rec.job_id, task=rec.name,

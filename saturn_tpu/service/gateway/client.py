@@ -32,7 +32,7 @@ from __future__ import annotations
 import random
 import socket
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from saturn_tpu.service.gateway import protocol
 from saturn_tpu.service.gateway.protocol import GatewayError
@@ -54,9 +54,22 @@ class GatewayClient:
         max_attempts: int = 8,
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 2.0,
+        endpoints: Optional[Sequence[Tuple[str, int]]] = None,
     ):
         self.host = host
         self.port = port
+        #: Replica endpoints, tried in rotation: ``(host, port)`` is always
+        #: first, extra ``endpoints`` follow. A transport failure or a
+        #: retriable refusal (GW_RETRY_AFTER from a non-leaseholder,
+        #: GW_STALE_EPOCH, GW_DRAINING) rotates to the next replica before
+        #: the retry — same frame, same dedup_key, so landing on a
+        #: different replica still maps to the original job id.
+        self.endpoints: List[Tuple[str, int]] = [(host, port)]
+        for ep in endpoints or ():
+            pair = (ep[0], int(ep[1]))
+            if pair not in self.endpoints:
+                self.endpoints.append(pair)
+        self._ep_idx = 0
         self.session = session or f"gwc-{seed}-{id(self) & 0xFFFF:04x}"
         self.timeout_s = timeout_s
         self.max_attempts = max_attempts
@@ -71,11 +84,34 @@ class GatewayClient:
         self.retries = 0
 
     # ------------------------------------------------------------- transport
+    def _rotate_endpoint(self) -> None:
+        """Point at the next replica (no-op with a single endpoint)."""
+        if len(self.endpoints) > 1:
+            self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
+
     def _connect(self) -> None:
         self.close()
-        sock = socket.create_connection(
-            (self.host, self.port), timeout=self.timeout_s
-        )
+        # Try every endpoint once, starting from the current rotation
+        # position: a dead replica costs one connect attempt, not the call.
+        last: Optional[BaseException] = None
+        sock = None
+        for i in range(len(self.endpoints)):
+            host, port = self.endpoints[
+                (self._ep_idx + i) % len(self.endpoints)
+            ]
+            try:
+                sock = socket.create_connection(
+                    (host, port), timeout=self.timeout_s
+                )
+                self._ep_idx = (self._ep_idx + i) % len(self.endpoints)
+                break
+            except OSError as e:
+                last = e
+        if sock is None:
+            raise ConnectionError(
+                f"no gateway replica reachable across "
+                f"{len(self.endpoints)} endpoint(s): {last}"
+            )
         self._sock = sock
         self._reader = sock.makefile("rb")
         # Session resume: re-associate this client's live jobs with the
@@ -165,10 +201,17 @@ class GatewayClient:
                     raise
                 last = e
                 hint = e.retry_after_s
+                if len(self.endpoints) > 1:
+                    # A retriable refusal from this replica (draining, not
+                    # the leaseholder, fenced mid-failover) — try a peer.
+                    self.close()
+                    self._rotate_endpoint()
             except (OSError, ConnectionError) as e:
                 # Transport died mid-request: drop the connection; the next
-                # attempt reconnects and resumes the session.
+                # attempt reconnects (rotating to a peer replica when one is
+                # configured) and resumes the session.
                 self.close()
+                self._rotate_endpoint()
                 last = e
                 hint = None
             self.retries += 1
@@ -188,7 +231,8 @@ class GatewayClient:
                name: Optional[str] = None,
                total_batches: Optional[int] = None,
                request_deadline_s: Optional[float] = None,
-               dedup_key: Optional[str] = None) -> str:
+               dedup_key: Optional[str] = None,
+               tenant: Optional[str] = None) -> str:
         """Enqueue a job; returns the job id (the original id on a retry).
 
         Accepts either a task object (its ``name``/``total_batches`` cross
@@ -196,7 +240,9 @@ class GatewayClient:
         explicit ``name=``/``total_batches=`` keywords. ``deadline_s`` is
         the *job's* completion deadline (the pressure shedder's input);
         ``request_deadline_s`` bounds only this submission's time-in-gateway
-        before admission.
+        before admission. ``tenant`` names the billing/fairness principal
+        (quotas, fair-share weighting, tenant-aware shedding); omitted, the
+        job runs under the default tenant.
         """
         if task is not None:
             name = getattr(task, "name", None)
@@ -223,6 +269,7 @@ class GatewayClient:
                 "deadline_s": deadline_s,
                 "max_retries": max_retries,
                 "spec": spec,
+                "tenant": tenant,
             },
         }
         if request_deadline_s is not None:

@@ -50,6 +50,12 @@ GW_DRAINING = "GW_DRAINING"                  # gateway draining, not accepting
 GW_UNKNOWN_JOB = "GW_UNKNOWN_JOB"            # status/wait/cancel on unknown id
 GW_INTERNAL = "GW_INTERNAL"                  # unexpected server-side exception
 GW_UNAVAILABLE = "GW_UNAVAILABLE"            # client-side: transport exhausted
+GW_TENANT_OVER_QUOTA = "GW_TENANT_OVER_QUOTA"  # tenant inflight window full
+#                                                (per-tenant backpressure; the
+#                                                global window may be fine)
+GW_STALE_EPOCH = "GW_STALE_EPOCH"            # replica lost the lease mid-
+#                                              request: fenced, nothing was
+#                                              admitted — retry (any replica)
 
 ERROR_CODES = frozenset({
     GW_BADFRAME,
@@ -61,11 +67,20 @@ ERROR_CODES = frozenset({
     GW_UNKNOWN_JOB,
     GW_INTERNAL,
     GW_UNAVAILABLE,
+    GW_TENANT_OVER_QUOTA,
+    GW_STALE_EPOCH,
 })
 
 #: Codes a client may transparently retry (with backoff / after
 #: ``retry_after_s``). Everything else is a terminal verdict for the call.
-RETRIABLE_CODES = frozenset({GW_RETRY_AFTER, GW_DRAINING, GW_UNAVAILABLE})
+#: ``GW_TENANT_OVER_QUOTA`` retries like ``GW_RETRY_AFTER`` (it carries the
+#: tenant's own ``retry_after_s``); ``GW_STALE_EPOCH`` retries because the
+#: fenced replica admitted nothing — the retry lands on (or re-elects) the
+#: current leaseholder and the dedup key maps it to one job id.
+RETRIABLE_CODES = frozenset({
+    GW_RETRY_AFTER, GW_DRAINING, GW_UNAVAILABLE,
+    GW_TENANT_OVER_QUOTA, GW_STALE_EPOCH,
+})
 
 
 class GatewayError(Exception):

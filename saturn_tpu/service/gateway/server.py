@@ -29,11 +29,29 @@ gateway owns the *wire* concerns the in-process client never had:
   :meth:`install_sigterm`) stops accepting connections and submissions,
   lets in-flight requests flush their responses, and journals a durable
   ``gateway_drain`` handoff marker with the shed/dedup ledger.
+- **Tenant windows.** When the service carries a ``tenancy`` ledger
+  (:class:`~saturn_tpu.tenancy.TenantLedger`), submissions are accounted
+  to their ``job.tenant`` and a per-tenant inflight window applies on top
+  of the global/session ones — refused with ``GW_TENANT_OVER_QUOTA`` and
+  the tenant's own ``retry_after_s``. Under admission pressure the window
+  shrink becomes *tenant-selective*: only tenants over their weighted
+  fair share are squeezed, so a bursty tenant backs off before a quiet
+  tenant loses a single slot.
+- **Replication.** N gateways can front one service
+  (``GatewayServer(service, replica_of=first, replica_id=..., lease=...)``):
+  replicas share the dedup table and an epoch-fenced
+  :class:`~saturn_tpu.tenancy.ReplicaLease` over the same durability
+  journal. Holding the lease is what authorizes recording a new
+  admission; a deposed replica's late submit is refused with
+  ``GW_STALE_EPOCH`` *before* anything is admitted, so a client retrying
+  a lost ACK against the surviving replica gets the original job id —
+  exactly-once across failover.
 
 Locks are named into the saturn-tsan graph (``gateway.conns``,
-``gateway.dedup``) with the acquisition order ``gateway.dedup →
-gateway.conns → …`` and ``gateway.dedup → queue.lock → journal.lock``;
-nothing ever acquires a gateway lock while holding a queue or journal lock.
+``gateway.dedup``, ``gateway.lease``) with the acquisition order
+``gateway.dedup → gateway.conns → …``, ``gateway.dedup → queue.lock →
+journal.lock`` and ``gateway.dedup → gateway.lease``; nothing ever
+acquires a gateway lock while holding a queue or journal lock.
 """
 
 from __future__ import annotations
@@ -50,6 +68,7 @@ from saturn_tpu.resilience.crash import SimulatedKill
 from saturn_tpu.service.gateway import protocol
 from saturn_tpu.service.gateway.protocol import GatewayError
 from saturn_tpu.service.queue import TERMINAL_STATES, JobRequest
+from saturn_tpu.tenancy.lease import LeaseHeld
 from saturn_tpu.utils import metrics
 
 logger = logging.getLogger("saturn_tpu")
@@ -98,6 +117,9 @@ class GatewayServer:
         pressure_cooldown_s: Optional[float] = None,
         retry_after_s: Optional[float] = None,
         wait_chunk_cap_s: float = 5.0,
+        replica_id: Optional[str] = None,
+        lease=None,
+        replica_of: Optional["GatewayServer"] = None,
     ):
         self.service = service
         self.host = host
@@ -122,18 +144,41 @@ class GatewayServer:
         # double-submit). Order: gateway.dedup → gateway.conns, never the
         # reverse.
         self._lock = tsan.rlock("gateway.conns")
-        self._dedup_lock = tsan.rlock("gateway.dedup")
+        if replica_of is not None:
+            # A replica of an existing gateway over the SAME service: the
+            # dedup table and its lock are shared objects, so check-then-
+            # record stays atomic across replicas, and the lease defaults
+            # to the peer's — one epoch sequence for the whole replica set.
+            if replica_of.service is not service:
+                raise ValueError(
+                    "replica_of must front the same SaturnService"
+                )
+            self._dedup_lock = replica_of._dedup_lock
+            self._dedup = replica_of._dedup
+            if lease is None:
+                lease = replica_of.lease
+        else:
+            self._dedup_lock = tsan.rlock("gateway.dedup")
+            # Exactly-once across restarts: seed the dedup table from the
+            # journal replay the service already performed.
+            self._dedup = dict(
+                getattr(service, "recovered_dedup", None) or {}
+            )
         self._conns: Dict[int, _Conn] = {}
         self._sessions: Dict[str, _Session] = {}
         self._sheds: Dict[str, int] = {}
         self._draining = False
         self._next_conn = 0
-        # Exactly-once across restarts: seed the dedup table from the
-        # journal replay the service already performed.
-        self._dedup: Dict[str, str] = dict(
-            getattr(service, "recovered_dedup", None) or {}
-        )
         self._dedup_hits = 0
+        #: This replica's identity in the lease protocol. Defaults to a
+        #: stable per-instance name so single-gateway deployments that pass
+        #: a lease still fence correctly.
+        self.replica_id = replica_id or f"gw-{id(self):x}"
+        #: Optional epoch-fenced ReplicaLease shared with peer replicas.
+        #: None = single-gateway mode, no fencing (exactly as before).
+        self.lease = lease
+        if self.lease is not None and self.lease.journal is None:
+            self.lease.journal = getattr(service, "journal", None)
 
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
@@ -219,6 +264,13 @@ class GatewayServer:
         with self._dedup_lock:
             dedup_entries = len(self._dedup)
             dedup_hits = self._dedup_hits
+        if self.lease is not None:
+            # Clean handoff: declare this replica dead and drop the lease so
+            # a peer takes over without waiting out the ttl. (The crash
+            # path — _die — deliberately does neither: a SIGKILLed replica
+            # can't, and the peer must win by ttl expiry.)
+            self.lease.mark_dead(self.replica_id)
+            self.lease.release(self.replica_id)
         jnl = self.service.journal
         if jnl is not None:
             # The durable clean-handoff marker: the analysis CLI and the
@@ -228,6 +280,7 @@ class GatewayServer:
                 "gateway_drain", reason=reason, clean=clean,
                 sessions=sessions, dedup_entries=dedup_entries,
                 dedup_hits=dedup_hits, sheds=sheds,
+                replica=self.replica_id,
             )
         metrics.event("gateway_drain", reason=reason, clean=clean,
                       sessions=sessions, sheds=sheds)
@@ -414,7 +467,22 @@ class GatewayServer:
         if not isinstance(job, dict) or not job.get("name"):
             raise GatewayError(protocol.GW_BADREQUEST,
                                "submit needs a job object with a name")
+        tenant = job.get("tenant")
+        if tenant is not None and not isinstance(tenant, str):
+            raise GatewayError(protocol.GW_BADREQUEST,
+                               f"job.tenant must be a string, got {tenant!r}")
         key = frame.get("dedup_key")
+        if key is not None and self.lease is not None:
+            # Serving an idempotent retry needs no lease — the dedup table
+            # is shared across replicas and the answer is already durable —
+            # so check it BEFORE the lease gate: a client failing over a
+            # lost ACK to a non-holder replica gets the original job id
+            # instead of bouncing back to the leaseholder.
+            with self._dedup_lock:
+                jid = self._dedup.get(key)
+                if jid is not None:
+                    return self._serve_dedup_hit(key, jid, session)
+        epoch = self._ensure_lease(session)
         sched_point("gateway.dedup")
         with self._dedup_lock:
             if key is not None:
@@ -422,21 +490,26 @@ class GatewayServer:
                 if jid is not None:
                     # Idempotent retry: the original admission stands; the
                     # lost-ACK window (connection drop, mid-ACK kill,
-                    # gateway restart) collapses to a lookup.
-                    self._dedup_hits += 1
-                    self._note_session_job(session, jid)
-                    jnl = self.service.journal
-                    if jnl is not None:
-                        jnl.append("gateway_dedup_hit", key=key, job=jid,
-                                   session=session)
-                    metrics.event("gateway_dedup_hit", key=key, job=jid,
-                                  session=session)
-                    return {"job_id": jid, "duplicate": True}
+                    # gateway restart, replica failover) collapses to a
+                    # lookup.
+                    return self._serve_dedup_hit(key, jid, session)
             # Shed expired work before admission: time spent waiting out the
             # dedup lock (the gateway's admission queue) counts against the
             # request's budget.
             self._check_deadline(frame, arrival, session, "submit")
-            self._check_window(session)
+            self._check_window(session, tenant)
+            # The fence, at the commit point: a replica deposed between its
+            # lease check and here (late ACK after failover) must not admit.
+            sched_point("gateway.lease")
+            if self.lease is not None \
+                    and not self.lease.check(self.replica_id, epoch):
+                self._shed("stale_epoch", session, "submit", tenant=tenant)
+                raise GatewayError(
+                    protocol.GW_STALE_EPOCH,
+                    f"replica {self.replica_id} holds stale lease epoch "
+                    f"{epoch} (current: {self.lease.epoch}) — nothing "
+                    "admitted; retry against the current leaseholder",
+                )
             task = self._build_task(job)
             req = JobRequest(
                 task=task,
@@ -445,6 +518,7 @@ class GatewayServer:
                 max_retries=int(job.get("max_retries", 1)),
                 spec=job.get("spec"),
                 dedup_key=key,
+                tenant=tenant,
             )
             try:
                 rec = self.service.queue.submit(req)
@@ -456,6 +530,41 @@ class GatewayServer:
                 self._dedup[key] = rec.job_id
             self._note_session_job(session, rec.job_id)
         return {"job_id": rec.job_id, "duplicate": False}
+
+    def _serve_dedup_hit(self, key: str, jid: str,
+                         session: Optional[str]) -> Dict[str, Any]:
+        """Answer a retried submit from the dedup table (caller holds the
+        dedup lock — it's an rlock, so both call sites are safe)."""
+        self._dedup_hits += 1
+        self._note_session_job(session, jid)
+        jnl = self.service.journal
+        if jnl is not None:
+            jnl.append("gateway_dedup_hit", key=key, job=jid,
+                       session=session, replica=self.replica_id)
+        metrics.event("gateway_dedup_hit", key=key, job=jid,
+                      session=session)
+        return {"job_id": jid, "duplicate": True}
+
+    def _ensure_lease(self, session: Optional[str]) -> Optional[int]:
+        """Hold (or take) the replica lease before touching admission state.
+
+        Returns the epoch to present at the commit-point fence, or None in
+        single-gateway mode. A live peer holding the lease turns into a
+        retriable refusal — the client's endpoint rotation finds the
+        leaseholder.
+        """
+        if self.lease is None:
+            return None
+        try:
+            return self.lease.ensure(self.replica_id)
+        except LeaseHeld as e:
+            self._shed("lease_held", session, "submit")
+            raise GatewayError(
+                protocol.GW_RETRY_AFTER,
+                f"replica {self.replica_id} is not the leaseholder "
+                f"({e.holder} is) — retry against it",
+                retry_after_s=e.retry_after_s,
+            ) from e
 
     def _op_status(self, frame: Dict[str, Any]) -> Dict[str, Any]:
         jid = self._job_id(frame)
@@ -538,29 +647,36 @@ class GatewayServer:
         return (last is not None
                 and time.monotonic() - last < self.pressure_cooldown_s)
 
-    def _check_window(self, session: Optional[str]) -> None:
+    def _check_window(self, session: Optional[str],
+                      tenant: Optional[str] = None) -> None:
+        tenancy = getattr(self.service, "tenancy", None)
         window = self.max_inflight
         pressured = self._pressure_active()
-        if pressured:
+        if pressured and tenancy is None:
             # The deadline-pressure shedder is evicting admitted work:
             # stop feeding it from the wire until the cooldown passes.
+            # (With a tenancy ledger the shrink is tenant-selective below —
+            # a quiet tenant keeps its full window.)
             window = max(1, int(window * self.pressure_window_factor))
         live = self.service.queue.live()
         if live >= window:
-            self._shed("retry_after", session, "submit")
+            self._shed("retry_after", session, "submit", tenant=tenant)
             raise GatewayError(
                 protocol.GW_RETRY_AFTER,
                 f"{live} live job(s) >= window {window}"
                 + (" (pressure-shrunk)" if pressured else ""),
                 retry_after_s=self.retry_after_s,
             )
+        if tenancy is not None:
+            self._check_tenant_window(session, tenant, tenancy, pressured)
         if session is not None:
             with self._lock:
                 sess = self._sessions.get(session)
                 jobs = list(sess.jobs) if sess is not None else []
             sess_live = sum(1 for jid in jobs if self._live_state(jid))
             if sess_live >= self.max_inflight_per_session:
-                self._shed("retry_after_session", session, "submit")
+                self._shed("retry_after_session", session, "submit",
+                           tenant=tenant)
                 raise GatewayError(
                     protocol.GW_RETRY_AFTER,
                     f"session {session} has {sess_live} live job(s) >= "
@@ -568,13 +684,47 @@ class GatewayServer:
                     retry_after_s=self.retry_after_s,
                 )
 
-    def _shed(self, reason: str, session: Optional[str], op: str) -> None:
+    def _check_tenant_window(self, session: Optional[str],
+                             tenant: Optional[str], tenancy,
+                             pressured: bool) -> None:
+        """Per-tenant inflight window, pressure-shrunk only for tenants over
+        their weighted fair share — the tenant-aware half of backpressure."""
+        quota = tenancy.quota(tenant)
+        window = quota.max_inflight
+        squeezed = False
+        if pressured:
+            counts = self.service.queue.live_by_tenant()
+            if tenancy.over_fair_share(tenant, counts):
+                base = window if window is not None else self.max_inflight
+                window = max(1, int(base * self.pressure_window_factor))
+                squeezed = True
+        if window is None:
+            return
+        tenant_live = self.service.queue.live_tenant(tenant)
+        if tenant_live >= window:
+            tenancy.note_shed(tenant)
+            self._shed("tenant_over_quota", session, "submit", tenant=tenant)
+            raise GatewayError(
+                protocol.GW_TENANT_OVER_QUOTA,
+                f"tenant {tenancy.resolve(tenant)!r} has {tenant_live} live "
+                f"job(s) >= its window {window}"
+                + (" (pressure-shrunk: over fair share)" if squeezed else ""),
+                retry_after_s=(
+                    quota.retry_after_s if quota.retry_after_s is not None
+                    else self.retry_after_s
+                ),
+            )
+
+    def _shed(self, reason: str, session: Optional[str], op: str,
+              tenant: Optional[str] = None) -> None:
         with self._lock:
             self._sheds[reason] = self._sheds.get(reason, 0) + 1
         jnl = self.service.journal
         if jnl is not None:
-            jnl.append("gateway_shed", reason=reason, session=session, op=op)
-        metrics.event("gateway_shed", reason=reason, session=session, op=op)
+            jnl.append("gateway_shed", reason=reason, session=session, op=op,
+                       tenant=tenant, replica=self.replica_id)
+        metrics.event("gateway_shed", reason=reason, session=session, op=op,
+                      tenant=tenant)
 
     def _build_task(self, job: Dict[str, Any]) -> Any:
         provider = self.service.task_provider
@@ -597,6 +747,7 @@ class GatewayServer:
             "deadline_s": job.get("deadline_s"),
             "max_retries": int(job.get("max_retries", 1)),
             "spec": job.get("spec"),
+            "tenant": job.get("tenant"),
         })
         if getattr(task, "name", None) != name:
             raise GatewayError(
@@ -619,4 +770,7 @@ class GatewayServer:
         with self._dedup_lock:
             out["dedup_entries"] = len(self._dedup)
             out["dedup_hits"] = self._dedup_hits
+        out["replica_id"] = self.replica_id
+        if self.lease is not None:
+            out["lease"] = self.lease.snapshot()
         return out

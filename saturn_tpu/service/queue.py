@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 
 from saturn_tpu.analysis import concurrency as tsan
 from saturn_tpu.analysis.concurrency import sched_point
+from saturn_tpu.tenancy.model import DEFAULT_TENANT
 from saturn_tpu.utils import metrics
 
 
@@ -43,6 +44,13 @@ class JobState(str, enum.Enum):
 TERMINAL_STATES = frozenset(
     {JobState.DONE, JobState.FAILED, JobState.EVICTED}
 )
+
+#: States in which a job holds (or is about to hold) mesh resources. The
+#: per-tenant ``max_live_jobs`` admission gate counts these — NOT queued
+#: arrivals: gating on all non-terminal jobs would count a burst's own
+#: queued siblings and defer the whole burst forever (nothing admitted,
+#: nothing completing, nothing ever freeing a slot).
+_ADMITTED_STATES = frozenset({JobState.SCHEDULED, JobState.RUNNING})
 
 #: Legal transitions. QUEUED is re-enterable from PROFILING (admission
 #: defers work that cannot fit the current mesh), SCHEDULED (replan dropped
@@ -86,6 +94,12 @@ class JobRequest:
     #                                    retried network submit (lost ACK,
     #                                    gateway restart) maps back to this
     #                                    job id instead of admitting twice
+    tenant: Optional[str] = None       # billing/fairness principal; None
+    #                                    folds to the "default" tenant so
+    #                                    single-tenant deployments are
+    #                                    unchanged. Quotas, fair-share
+    #                                    weighting and tenant-aware shedding
+    #                                    all key on this
 
 
 @dataclass
@@ -116,11 +130,16 @@ class JobRecord:
     def name(self) -> str:
         return self.request.task.name
 
+    @property
+    def tenant(self) -> str:
+        return self.request.tenant or DEFAULT_TENANT
+
     def snapshot(self) -> dict:
         """Client-facing view — plain data, safe to hold across states."""
         return {
             "job_id": self.job_id,
             "task": self.name,
+            "tenant": self.tenant,
             "state": self.state.value,
             "priority": self.request.priority,
             "deadline_s": self.request.deadline_s,
@@ -156,6 +175,14 @@ class SubmissionQueue:
         #: scanning the whole registry — at twin-campaign scale (100k+
         #: submissions) the O(all-jobs-ever) scan per submit is quadratic.
         self._live_names: Dict[str, str] = {}
+        #: tenant -> live (non-terminal) job count, maintained alongside
+        #: ``_live_names`` so per-tenant windows and fair-share targets are
+        #: O(1) lookups instead of registry scans on the gateway hot path.
+        self._tenant_live: Dict[str, int] = {}
+        #: tenant -> jobs currently in an admitted state (SCHEDULED or
+        #: RUNNING); the admission quota gate's O(1) input (see
+        #: ``_ADMITTED_STATES`` for why this excludes queued arrivals).
+        self._tenant_admitted: Dict[str, int] = {}
         self._seq = 0
         #: Optional ``observer(event, rec, **fields)`` called under the queue
         #: lock after every registry mutation ("submitted" / "state" /
@@ -202,11 +229,14 @@ class SubmissionQueue:
             )
             self._jobs[rec.job_id] = rec
             self._live_names[name] = rec.job_id
+            self._tenant_live[rec.tenant] = (
+                self._tenant_live.get(rec.tenant, 0) + 1
+            )
             self._arrivals.append(rec.job_id)
             self._notify_observer("submitted", rec)
             self._cond.notify_all()
         metrics.event(
-            "job_submitted", job=rec.job_id, task=name,
+            "job_submitted", job=rec.job_id, task=name, tenant=rec.tenant,
             priority=request.priority, deadline_s=request.deadline_s,
         )
         return rec
@@ -242,6 +272,13 @@ class SubmissionQueue:
             self._jobs[rec.job_id] = rec
             if rec.state not in TERMINAL_STATES:
                 self._live_names[name] = rec.job_id
+                self._tenant_live[rec.tenant] = (
+                    self._tenant_live.get(rec.tenant, 0) + 1
+                )
+                if rec.state in _ADMITTED_STATES:
+                    self._tenant_admitted[rec.tenant] = (
+                        self._tenant_admitted.get(rec.tenant, 0) + 1
+                    )
                 if rec.job_id not in self._arrivals:
                     self._arrivals.append(rec.job_id)
                 self._notify_observer("recovered", rec)
@@ -302,10 +339,26 @@ class SubmissionQueue:
                     f"illegal job transition {rec.state.value} -> "
                     f"{state.value} for {rec.job_id}"
                 )
+            was_admitted = rec.state in _ADMITTED_STATES
             rec.state = state
+            if state in _ADMITTED_STATES and not was_admitted:
+                self._tenant_admitted[rec.tenant] = (
+                    self._tenant_admitted.get(rec.tenant, 0) + 1
+                )
+            elif was_admitted and state not in _ADMITTED_STATES:
+                n = self._tenant_admitted.get(rec.tenant, 0) - 1
+                if n > 0:
+                    self._tenant_admitted[rec.tenant] = n
+                else:
+                    self._tenant_admitted.pop(rec.tenant, None)
             if state in TERMINAL_STATES:
                 if self._live_names.get(rec.name) == rec.job_id:
                     del self._live_names[rec.name]
+                    n = self._tenant_live.get(rec.tenant, 0) - 1
+                    if n > 0:
+                        self._tenant_live[rec.tenant] = n
+                    else:
+                        self._tenant_live.pop(rec.tenant, None)
             now = time.monotonic()
             if state is JobState.SCHEDULED:
                 if rec.admitted_at is None:  # first admission outcome
@@ -345,6 +398,23 @@ class SubmissionQueue:
         """Jobs in any non-terminal state."""
         with self._lock:
             return len(self._live_names)
+
+    def live_tenant(self, tenant: Optional[str]) -> int:
+        """Non-terminal jobs accounted to ``tenant`` (None = default)."""
+        with self._lock:
+            return self._tenant_live.get(tenant or DEFAULT_TENANT, 0)
+
+    def admitted_tenant(self, tenant: Optional[str]) -> int:
+        """Jobs accounted to ``tenant`` in an admitted state (SCHEDULED or
+        RUNNING) — what the ``max_live_jobs`` quota gate counts. Queued
+        arrivals are deliberately excluded: see ``_ADMITTED_STATES``."""
+        with self._lock:
+            return self._tenant_admitted.get(tenant or DEFAULT_TENANT, 0)
+
+    def live_by_tenant(self) -> Dict[str, int]:
+        """tenant -> live job count (fair-share input; copy, safe to hold)."""
+        with self._lock:
+            return dict(self._tenant_live)
 
     def compact(self) -> int:
         """Drop terminal job records from the registry; returns how many were

@@ -50,6 +50,25 @@ from saturn_tpu.utils import metrics
 logger = logging.getLogger("saturn_tpu")
 
 
+def _estimate_chip_seconds(task, batches: int) -> float:
+    """Chip-second burn for ``batches`` realized iterations: the task's
+    cheapest feasible (size, strategy) point prices one batch at
+    ``per_batch_time * size`` — a minimum-burn basis, so billing reflects
+    the job's own cost floor rather than whatever placement the scheduler
+    happened to pick this interval. Priced off ``per_batch_time`` (stable
+    across the run), NOT ``runtime``: the feedback fold re-derives runtime
+    from *remaining* work, so at completion — exactly when this bills —
+    runtime has decayed to zero."""
+    feas = task.feasible_strategies()
+    if not feas or batches <= 0:
+        return 0.0
+    rates = [s.per_batch_time * g for g, s in feas.items()
+             if (s.per_batch_time or 0.0) > 0.0]
+    if not rates:
+        return 0.0
+    return batches * min(rates)
+
+
 class SaturnService:
     """Long-running scheduler over one slice topology.
 
@@ -79,6 +98,8 @@ class SaturnService:
         task_provider=None,
         crash_barrier=None,
         health_guardian=None,
+        tenancy=None,
+        compile_ahead=None,
         poll_s: float = 0.05,
         log: bool = False,
     ):
@@ -148,6 +169,21 @@ class SaturnService:
         #: gateway reads it to shrink its inflight window while the shedder
         #: is active (wire-level backpressure follows mesh-level pressure).
         self.last_pressure_shed: Optional[float] = None
+        #: Tenancy subsystem (``saturn_tpu/tenancy``): the fairness/quota
+        #: ledger shared by admission, the gateway replicas, and the
+        #: pressure shedder — plus the background compile-ahead pool that
+        #: starts AOT compilation the moment admission picks a strategy.
+        #: Wired *before* recovery so replayed ``tenant_charge`` records
+        #: re-seat the ledger and both components get the journal.
+        self.tenancy = tenancy
+        self.compile_ahead = compile_ahead
+        self.admission.tenancy = tenancy
+        #: Lease epoch/owner folded from journaled ``gateway_lease``
+        #: records: a restarted gateway replica set seeds its
+        #: ``ReplicaLease`` from these, so a deposed pre-crash epoch can
+        #: never be re-issued after a failover + restart.
+        self.recovered_lease_epoch = 0
+        self.recovered_lease_owner: Optional[str] = None
         if durability_dir is not None:
             self._recover_from(durability_dir, crash_barrier)
         elif crash_barrier is not None:
@@ -181,8 +217,19 @@ class SaturnService:
         self.journal = jmod.Journal(durability_dir, barrier=crash_barrier)
         self.queue.observer = self._observe_job
         self.admission.journal = self.journal
+        if self.tenancy is not None and self.tenancy.journal is None:
+            self.tenancy.journal = self.journal
+        if (self.compile_ahead is not None
+                and getattr(self.compile_ahead, "journal", None) is None):
+            self.compile_ahead.journal = self.journal
         state = rmod.replay_service_state(durability_dir)
         self.recovered_dedup = dict(state.dedup)
+        self.recovered_lease_epoch = state.lease_epoch
+        self.recovered_lease_owner = state.lease_owner
+        if self.tenancy is not None and state.tenant_charges:
+            # Exactly-once burn accounting across the crash: the folded
+            # journal totals replace (never add to) the fresh counters.
+            self.tenancy.restore(state.tenant_charges)
         if state.checkpoints:
             rmod.reconcile_checkpoints(state.checkpoints)
         if state.jobs:
@@ -264,6 +311,7 @@ class SaturnService:
                 total_batches=getattr(rec.task, "total_batches", None),
                 spec=rec.request.spec,
                 dedup_key=rec.request.dedup_key,
+                tenant=rec.request.tenant,
             )
         elif event == "recovered":
             jnl.append(
@@ -305,6 +353,8 @@ class SaturnService:
         self._stop.set()  # an idle loop re-checks every poll_s
         if self._thread is not None:
             self._thread.join(timeout)
+        if self.compile_ahead is not None and not self.killed:
+            self.compile_ahead.close()
         if self._error is not None and not self.killed:
             raise RuntimeError("service loop crashed") from self._error
 
@@ -424,6 +474,7 @@ class SaturnService:
 
                 # 2. drain arrivals through admission
                 newly_admitted: List[JobRecord] = []
+                self.admission.begin_pass()
                 for rec in self.queue.drain():
                     if rec.cancel_requested:
                         self.queue.mark(rec, JobState.EVICTED,
@@ -442,6 +493,7 @@ class SaturnService:
                     if dec.action == ADMIT:
                         jobs[rec.name] = rec
                         newly_admitted.append(rec)
+                        self._prewarm_admitted(rec, topo)
                     elif dec.action == DEFER:
                         self.queue.requeue(rec)
                     else:  # REJECT
@@ -717,18 +769,50 @@ class SaturnService:
         """Engine per-task completion hook → buffered ``task_progress``
         records (durable at the interval-end group commit). This is the
         exactly-once ledger: recovery subtracts these from the budget, so a
-        batch is journaled only after its iterations really ran."""
+        batch is journaled only after its iterations really ran. With a
+        tenant ledger wired, each realized batch also burns the owning
+        tenant's chip-seconds (same buffered-append durability contract)."""
         jnl = self.journal
-        if jnl is None:
+        tenancy = self.tenancy
+        if jnl is None and tenancy is None:
             return None
-        ids = {name: rec.job_id for name, rec in jobs.items()}
+        recs = {name: rec for name, rec in jobs.items()}
 
         def on_done(name: str, batches: int) -> None:
-            if batches > 0:
-                jnl.append("task_progress", task=name, job=ids.get(name),
+            if batches <= 0:
+                return
+            rec = recs.get(name)
+            if jnl is not None:
+                jnl.append("task_progress", task=name,
+                           job=rec.job_id if rec is not None else None,
                            batches=int(batches))
+            if tenancy is not None and rec is not None:
+                chip_s = _estimate_chip_seconds(rec.task, batches)
+                if chip_s > 0.0:
+                    tenancy.charge(rec.tenant, chip_s, job=rec.job_id)
 
         return on_done
+
+    def _prewarm_admitted(self, rec: JobRecord, topo) -> None:
+        """Compile-ahead: the moment admission picks this job's strategy
+        set, hand its compilables to the background pool so the first
+        dispatch finds a warm executable instead of blocking on XLA.
+        Duck-typed: a task exposing ``compile_ahead(topology)`` yields
+        ``(key, thunk)`` pairs; tasks without the hook cost nothing."""
+        pool = self.compile_ahead
+        if pool is None:
+            return
+        hook = getattr(rec.task, "compile_ahead", None)
+        if hook is None:
+            return
+        try:
+            pairs = hook(topo) or ()
+        except Exception as e:
+            logger.debug("compile-ahead hook failed for %s: %r",
+                         rec.job_id, e)
+            return
+        for key, thunk in pairs:
+            pool.prewarm(key, thunk, job=rec.job_id, tenant=rec.tenant)
 
     def _evict(self, jobs: Dict[str, JobRecord], rec: JobRecord,
                reason: str) -> None:
@@ -751,7 +835,7 @@ class SaturnService:
     def _shed_pressure(self, jobs: Dict[str, JobRecord], topo,
                        plan: Optional[milp.Plan]) -> None:
         shed, proj, limit = project_pressure_shed(
-            jobs, topo, plan, self.pressure_policy
+            jobs, topo, plan, self.pressure_policy, tenancy=self.tenancy
         )
         if shed:
             # Signal wire-level backpressure: the gateway shrinks its
@@ -762,16 +846,25 @@ class SaturnService:
                 "admission pressure: evicting %s (projection %.2fs > "
                 "slack %.2fs)", rec.job_id, proj, limit,
             )
+            if self.tenancy is not None:
+                self.tenancy.note_shed(rec.tenant)
             self._evict(jobs, rec, "admission-pressure")
 
 
 def project_pressure_shed(jobs: Dict[str, JobRecord], topo,
                           plan: Optional[milp.Plan],
-                          pressure_policy: str):
+                          pressure_policy: str,
+                          tenancy=None):
     """Deadline-protecting load shed. The tightest remaining deadline
     slack bounds the projected (greedy, pessimistic) makespan; when the
     projection overshoots, the configured replanner eviction policy
     picks the casualties — lowest ``hints['priority']`` first.
+
+    With a :class:`~saturn_tpu.tenancy.model.TenantLedger`, the policy's
+    candidate pool is narrowed to jobs owned by *over-fair-share* tenants
+    first: a noisy neighbour's burst sheds its own work before a quiet
+    tenant loses anything already admitted. Only when no over-share
+    tenant is live does the policy consider the whole set.
 
     Module-level so simulated loop drivers (the twin campaign runner) run
     the *identical* shedding decision the service does; returns
@@ -799,7 +892,16 @@ def project_pressure_shed(jobs: Dict[str, JobRecord], topo,
         topology=topo, previous_plan=plan, previous_makespan=limit,
         change_kind="admission-pressure", degrade_factor=1.0,
     )
-    _keep, shed = get_policy(pressure_policy)(tasks, ctx)
+    candidates = tasks
+    if tenancy is not None:
+        live: Dict[str, int] = {}
+        for r in jobs.values():
+            live[r.tenant] = live.get(r.tenant, 0) + 1
+        over = tenancy.over_share_tenants(live)
+        over_tasks = [r.task for r in jobs.values() if r.tenant in over]
+        if over_tasks and len(over_tasks) < len(tasks):
+            candidates = over_tasks
+    _keep, shed = get_policy(pressure_policy)(candidates, ctx)
     by_name = {r.name: r for r in jobs.values()}
     return (
         [by_name[t.name] for t in shed if t.name in by_name],

@@ -1,0 +1,37 @@
+"""saturn-tenancy: multi-tenant control plane for the shared fleet.
+
+Saturn's framing is explicitly multi-client — many users submitting
+batches of training jobs against one shared fleet (arxiv 2311.02840) —
+but a queue + single gateway treats every submitter as the same
+principal. This package adds the three pieces that make the front door
+a control plane instead of a socket:
+
+- ``model`` — tenant identity, per-tenant quotas (max live jobs,
+  chip-seconds budget, inflight window) and the weighted fair-share
+  ledger the admission controller and gateway consult. Charges are
+  journaled (``tenant_charge``) so budgets survive crash-replay.
+- ``lease`` — an epoch-fenced leader lease shared by gateway replicas
+  over one durability journal: exactly-once admission across replica
+  failover, with a deposed replica's late admissions refused by fence.
+- ``compile_ahead`` — a background compile pool over the AOT executable
+  cache that starts compiling the moment admission picks a strategy,
+  so an admitted job's first dispatch never blocks on XLA.
+
+Import-light (stdlib + saturn-tsan factories only at import time): the
+gateway and service import this on their hot paths.
+"""
+
+from __future__ import annotations
+
+from saturn_tpu.tenancy.compile_ahead import CompileAheadPool
+from saturn_tpu.tenancy.lease import LeaseHeld, ReplicaLease
+from saturn_tpu.tenancy.model import DEFAULT_TENANT, TenantLedger, TenantQuota
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "TenantQuota",
+    "TenantLedger",
+    "ReplicaLease",
+    "LeaseHeld",
+    "CompileAheadPool",
+]

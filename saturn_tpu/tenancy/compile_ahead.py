@@ -1,0 +1,228 @@
+"""Compile-ahead: move XLA compile latency off the first-dispatch path.
+
+Admission picks a strategy from shardflow/memlens static priors — often
+minutes before the solver actually places the job's first task on a
+slice. Today the price of that strategy's XLA compile is paid at first
+dispatch, inside the execution interval. The pool here pays it in the
+background instead: the service submits a compile thunk the moment a
+job is ADMITted, worker threads compile it (writing through
+``utils/aot_cache`` so the executable is also durable on disk when the
+cache is enabled), and the dispatch path ``acquire``s the finished
+executable — a *hit* means zero compile wait.
+
+Every lifecycle step journals a ``compile_ahead`` event
+(``requested`` / ``ready`` / ``error`` / ``hit`` / ``miss``) so the
+hit/miss ledger survives in the durable record and the operator CLI can
+report the warm-phase hit rate.
+
+Compilation is arbitrary user code to this module: thunks run strictly
+OUTSIDE the pool lock (a multi-minute XLA compile under a lock is the
+SAT-C003 stall class), and a thunk's exception is a ledger entry, not a
+pool crash.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from saturn_tpu.analysis import concurrency as tsan
+
+__all__ = ["CompileAheadPool"]
+
+
+class CompileAheadPool:
+    """Background compile workers + the hit/miss ledger.
+
+    Keys are caller-chosen strings; for real SPMD bundles use
+    :meth:`prewarm_lowered` (keys by ``aot_cache.cache_key`` so the
+    disk cache and the pool agree on identity), for tests/benchmarks
+    any stable string works.
+    """
+
+    def __init__(self, *, workers: int = 2, journal: Any = None) -> None:
+        self._lock = tsan.lock("tenancy.compile_pool")
+        self._cond = tsan.condition(self._lock, "tenancy.compile_pool.cond")
+        self._pending: deque = deque()   # (key, thunk, job, tenant)
+        self._inflight: set = set()      # keys queued or compiling
+        self._ready: Dict[str, Any] = {}
+        self._errors: Dict[str, str] = {}
+        self._counts: Dict[str, int] = {
+            "requested": 0, "ready": 0, "errors": 0,
+            "ahead_hits": 0, "ahead_misses": 0, "duplicates": 0,
+        }
+        self._closed = False
+        self._workers = max(1, int(workers))
+        self._threads: list = []
+        #: Durable journal for compile_ahead events (wired by the service).
+        self.journal = journal
+
+    # -- producer side --------------------------------------------------
+
+    def prewarm(self, key: str, thunk: Callable[[], Any], *,
+                job: Optional[str] = None,
+                tenant: Optional[str] = None) -> bool:
+        """Queue ``thunk`` to compile ``key`` in the background.
+
+        Returns False (and counts a duplicate) when ``key`` is already
+        ready, inflight, or failed — re-admitting a requeued job must
+        not recompile.
+        """
+        with self._lock:
+            if self._closed:
+                return False
+            if key in self._ready or key in self._inflight \
+                    or key in self._errors:
+                self._counts["duplicates"] += 1
+                return False
+            self._inflight.add(key)
+            self._counts["requested"] += 1
+            self._pending.append((key, thunk, job, tenant))
+            self._spawn_locked()
+            self._cond.notify()
+        self._journal_event("requested", key, job=job, tenant=tenant)
+        return True
+
+    def prewarm_lowered(self, lowered: Any, devices: Any = None, *,
+                        job: Optional[str] = None,
+                        tenant: Optional[str] = None) -> Optional[str]:
+        """Prewarm a real lowered computation through the AOT cache.
+
+        Returns the cache key (also usable with :meth:`acquire`), or
+        None when the lowering has no stable identity. The compiled
+        executable additionally lands in ``aot_cache``'s in-process warm
+        pool, so ``Bundle.compiled`` — which calls
+        ``aot_cache.load_or_compile`` — hits it with no dispatch-path
+        changes.
+        """
+        from saturn_tpu.utils import aot_cache
+
+        if devices is None:
+            devices = ()
+        try:
+            key = aot_cache.cache_key(lowered, devices)
+        except Exception:
+            key = None
+        if key is None:
+            return None
+        self.prewarm(key, lambda: aot_cache.prewarm(lowered, devices),
+                     job=job, tenant=tenant)
+        return key
+
+    # -- consumer side --------------------------------------------------
+
+    def acquire(self, key: str, timeout: float = 0.0) -> Optional[Any]:
+        """Fetch the compiled artifact for ``key`` if compile-ahead won.
+
+        Returns the artifact on a hit (counts ``ahead_hits``); None on a
+        miss (never requested, failed, or not ready within ``timeout``)
+        — the caller compiles synchronously exactly as before.
+        """
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        with self._lock:
+            while True:
+                if key in self._ready:
+                    self._counts["ahead_hits"] += 1
+                    result = self._ready[key]
+                    hit = True
+                    break
+                waitable = key in self._inflight and not self._closed
+                remaining = deadline - time.monotonic()
+                if not waitable or remaining <= 0.0:
+                    self._counts["ahead_misses"] += 1
+                    result, hit = None, False
+                    break
+                self._cond.wait(timeout=min(remaining, 0.5))
+        self._journal_event("hit" if hit else "miss", key)
+        return result
+
+    def error(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._errors.get(key)
+
+    def ledger(self) -> Dict[str, Any]:
+        """Counts + derived hit rate (None until anything was acquired)."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counts)
+            out["pending"] = len(self._pending)
+            out["inflight"] = len(self._inflight)
+        asked = out["ahead_hits"] + out["ahead_misses"]
+        out["hit_rate"] = (out["ahead_hits"] / asked) if asked else None
+        return out
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued compile finished (tests/benchmarks)."""
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        with self._lock:
+            while self._pending or self._inflight:
+                remaining = 0.5 if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining <= 0.0:
+                    return False
+                self._cond.wait(timeout=min(remaining, 0.5))
+        return True
+
+    def close(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._closed = True
+            self._cond.notify_all()
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=timeout)
+
+    # -- workers --------------------------------------------------------
+
+    def _spawn_locked(self) -> None:
+        # Called under self._lock: lazily grow the worker set up to the
+        # cap so an idle service never carries compile threads.
+        while len(self._threads) < self._workers \
+                and len(self._threads) < len(self._pending) + len(
+                    self._inflight):
+            t = threading.Thread(
+                target=self._worker,
+                name=f"compile-ahead-{len(self._threads)}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending and not self._closed:
+                    self._cond.wait(timeout=0.5)
+                if self._closed and not self._pending:
+                    return
+                key, thunk, job, tenant = self._pending.popleft()
+            try:
+                result = thunk()
+                err = None
+            except Exception as e:  # a thunk's failure is a ledger entry
+                result, err = None, f"{type(e).__name__}: {e}"
+            with self._lock:
+                self._inflight.discard(key)
+                if err is None:
+                    self._ready[key] = result
+                    self._counts["ready"] += 1
+                else:
+                    self._errors[key] = err
+                    self._counts["errors"] += 1
+                self._cond.notify_all()
+            if err is None:
+                self._journal_event("ready", key, job=job, tenant=tenant)
+            else:
+                self._journal_event("error", key, job=job, tenant=tenant,
+                                    error=err)
+
+    def _journal_event(self, status: str, key: str, **extra: Any) -> None:
+        jnl = self.journal
+        if jnl is None:
+            return
+        try:
+            jnl.append("compile_ahead", status=status, key=key,
+                       **{k: v for k, v in extra.items() if v is not None})
+        except Exception:
+            pass  # a closed/rotating journal must not break compiles

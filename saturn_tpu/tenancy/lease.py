@@ -1,0 +1,155 @@
+"""Epoch-fenced leader lease for gateway replicas sharing one journal.
+
+N gateway replicas front the same :class:`SaturnService` (one queue,
+one durability journal, one dedup table). Exactly-once admission across
+*replica failover* needs one more invariant than the journaled dedup
+table gives us: a replica that was deposed mid-request must not record
+a dedup entry or ACK a submission *after* its successor has taken over
+— otherwise a client that already retried against the new leader could
+see two job ids for one logical submit.
+
+The lease provides that fence:
+
+- One replica holds the lease at a time; holding it is what authorizes
+  recording new admissions. ``ensure(owner)`` acquires (bumping the
+  **epoch**) when the lease is free, expired past ``ttl_s``, or the
+  holder was marked dead; it renews when ``owner`` already holds it;
+  otherwise it raises :class:`LeaseHeld` (the gateway maps this to a
+  retriable ``GW_RETRY_AFTER``).
+- ``check(owner, epoch)`` is the fence, evaluated at the admission
+  commit point (under the dedup lock, immediately before the dedup
+  record is written): a deposed replica — one whose epoch is no longer
+  current — gets ``False`` and must refuse with ``GW_STALE_EPOCH``
+  instead of admitting.
+- Every acquisition appends a durable ``gateway_lease`` record
+  ``{epoch, owner, prev_owner}``; recovery folds the max epoch so a
+  restarted control plane continues the epoch sequence instead of
+  reusing fenced epochs.
+
+The journal write happens *outside* the lease lock (the record is
+decided under the lock, written after release) — fsync under a lock is
+exactly the SAT-C003 stall saturn-tsan exists to catch. Two concurrent
+acquisitions may therefore journal out of order; recovery takes the max
+epoch, so ordering of the durable records is immaterial.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from saturn_tpu.analysis import concurrency as tsan
+
+__all__ = ["LeaseHeld", "ReplicaLease"]
+
+
+class LeaseHeld(RuntimeError):
+    """The lease is held by a live peer; retry after ``retry_after_s``."""
+
+    def __init__(self, holder: str, retry_after_s: float) -> None:
+        super().__init__(f"lease held by {holder!r}")
+        self.holder = holder
+        self.retry_after_s = retry_after_s
+
+
+class ReplicaLease:
+    """In-process lease shared by the gateway replicas of one service.
+
+    Replicas here are threads (accept loops) over one journal, so the
+    lease itself is a lock-guarded object; the *durable* part — the
+    epoch sequence — is journaled, which is what makes fencing survive
+    a control-plane restart.
+    """
+
+    def __init__(self, journal: Any = None, *, ttl_s: float = 2.0,
+                 epoch: int = 0, owner: Optional[str] = None) -> None:
+        self._lock = tsan.rlock("gateway.lease")
+        #: Durable journal for gateway_lease records (wired by the service;
+        #: replays seed ``epoch`` so fenced epochs are never reused).
+        self.journal = journal
+        self.ttl_s = float(ttl_s)
+        self._epoch = int(epoch)
+        self._owner = owner
+        self._renewed_at: Optional[float] = None
+        self._dead: set = set()
+        #: In-process acquisition history [(epoch, owner, prev_owner)].
+        self.history: List[Tuple[int, str, Optional[str]]] = []
+
+    # -- introspection --------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def owner(self) -> Optional[str]:
+        with self._lock:
+            return self._owner
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "owner": self._owner,
+                "ttl_s": self.ttl_s,
+                "dead": sorted(self._dead),
+                "acquisitions": len(self.history),
+            }
+
+    # -- the protocol ---------------------------------------------------
+
+    def ensure(self, owner: str) -> int:
+        """Hold (or take) the lease for ``owner``; returns the epoch.
+
+        The returned epoch is what the caller must later present to
+        :meth:`check` at its commit point — holding a *stale* epoch is
+        how a deposed replica discovers it was fenced.
+        """
+        now = time.monotonic()
+        record = None
+        with self._lock:
+            if self._owner == owner:
+                self._renewed_at = now
+                epoch = self._epoch
+            else:
+                holder = self._owner
+                expired = (
+                    self._renewed_at is None
+                    or now - self._renewed_at >= self.ttl_s
+                )
+                if holder is not None and holder not in self._dead \
+                        and not expired:
+                    remaining = self.ttl_s - (now - (self._renewed_at or now))
+                    raise LeaseHeld(holder, max(0.01, remaining))
+                self._epoch += 1
+                self._owner = owner
+                self._renewed_at = now
+                self._dead.discard(owner)
+                epoch = self._epoch
+                record = (epoch, owner, holder)
+                self.history.append(record)
+        if record is not None and self.journal is not None:
+            self.journal.log("gateway_lease", epoch=record[0],
+                            owner=record[1], prev_owner=record[2])
+        return epoch
+
+    def check(self, owner: str, epoch: int) -> bool:
+        """The fence: is ``owner``'s ``epoch`` still the current lease?"""
+        with self._lock:
+            return self._owner == owner and self._epoch == int(epoch)
+
+    def mark_dead(self, owner: str) -> None:
+        """Declare ``owner`` gone (clean shutdown, or a failure detector's
+        verdict) so a peer can take over without waiting out the ttl. The
+        epoch does NOT advance here — only the successor's acquisition
+        bumps it, which is what fences the dead replica's stragglers."""
+        with self._lock:
+            self._dead.add(owner)
+
+    def release(self, owner: str) -> None:
+        """Drop the lease iff ``owner`` holds it (clean drain handoff)."""
+        with self._lock:
+            if self._owner == owner:
+                self._owner = None
+                self._renewed_at = None

@@ -1,0 +1,242 @@
+"""Tenant identity, quotas, and the weighted fair-share ledger.
+
+A *tenant* is the billing/fairness principal a submission runs under.
+Every ``JobRequest`` carries an optional ``tenant`` id (``None`` folds
+to :data:`DEFAULT_TENANT`), and one :class:`TenantLedger` — shared by
+the admission controller, every gateway replica, and the pressure
+shedder — answers three questions about it:
+
+- **quota**: is this tenant allowed another live job / another inflight
+  submission, and does it still have chip-seconds budget?
+- **fair share**: given who is live right now, is this tenant over its
+  weighted slice of the fleet, and how should its next job's solver
+  weight be scaled?
+- **ledger**: what has it admitted, shed, and burned so far?
+
+Chip-second charges are journaled as ``tenant_charge`` records so the
+budget survives crash-replay exactly-once (recovery folds the records
+and :meth:`TenantLedger.restore` re-seats the counters). Everything
+else is derivable: live counts come from the queue, admit/shed tallies
+from ``job_admission`` / ``gateway_shed`` records.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, Mapping, Optional
+
+from saturn_tpu.analysis import concurrency as tsan
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "TenantQuota",
+    "TenantLedger",
+]
+
+#: The tenant a tag-less submission is accounted under. Single-tenant
+#: deployments never name a tenant and behave exactly as before.
+DEFAULT_TENANT = "default"
+
+#: Fair-share weight multipliers are clamped to this band so a wildly
+#: over/under-share tenant cannot zero out (or dominate) the solver's
+#: priority/deadline weighting — fairness nudges, deadlines still rule.
+_FAIR_SHARE_CLAMP = (0.25, 4.0)
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant limits; ``None`` means unlimited (the default quota).
+
+    ``max_live_jobs`` caps jobs in non-terminal states (admission DEFERs
+    past it); ``chip_seconds`` is a cumulative burn budget (admission
+    REJECTs once exhausted); ``max_inflight`` is the gateway-side
+    submission window (sheds with ``GW_TENANT_OVER_QUOTA``);
+    ``weight`` is the fair-share weight (2.0 = entitled to twice the
+    slice of a weight-1.0 tenant); ``retry_after_s`` rides tenant sheds
+    so a bursty client backs off on its own schedule.
+    """
+
+    max_live_jobs: Optional[int] = None
+    chip_seconds: Optional[float] = None
+    max_inflight: Optional[int] = None
+    weight: float = 1.0
+    retry_after_s: Optional[float] = None
+
+
+class TenantLedger:
+    """Quota book + fair-share arithmetic for every known tenant.
+
+    Thread-safe: the gateway replicas' accept loops, the service loop's
+    admission pass, and recovery all touch it. Lock order: the ledger
+    lock (``tenancy.quota``) may be held while appending to the journal
+    (``tenancy.quota`` -> ``journal.lock`` mirrors the existing
+    ``queue.lock`` -> ``journal.lock`` edge); nothing acquires the
+    ledger lock while holding a gateway or queue lock's *inner* locks.
+    """
+
+    def __init__(
+        self,
+        quotas: Optional[Mapping[str, TenantQuota]] = None,
+        *,
+        default: Optional[TenantQuota] = None,
+    ) -> None:
+        self._lock = tsan.rlock("tenancy.quota")
+        self._quotas: Dict[str, TenantQuota] = dict(quotas or {})
+        self._default = default if default is not None else TenantQuota()
+        self._charged: Dict[str, float] = {}   # tenant -> chip-seconds burned
+        self._admitted: Dict[str, int] = {}    # tenant -> jobs ADMITted
+        self._shed: Dict[str, int] = {}        # tenant -> gateway sheds
+        #: Durable journal for tenant_charge records (wired by the service).
+        self.journal = None
+
+    # -- quota lookup ---------------------------------------------------
+
+    @staticmethod
+    def resolve(tenant: Optional[str]) -> str:
+        """Fold a missing tenant tag to the accounting default."""
+        return tenant if tenant else DEFAULT_TENANT
+
+    def quota(self, tenant: Optional[str]) -> TenantQuota:
+        with self._lock:
+            return self._quotas.get(self.resolve(tenant), self._default)
+
+    def set_quota(self, tenant: str, quota: TenantQuota) -> None:
+        with self._lock:
+            self._quotas[self.resolve(tenant)] = quota
+
+    def tenants(self) -> list:
+        """Every tenant with a quota or any ledger activity, sorted."""
+        with self._lock:
+            names = set(self._quotas)
+            names.update(self._charged)
+            names.update(self._admitted)
+            names.update(self._shed)
+            return sorted(names)
+
+    # -- the ledger -----------------------------------------------------
+
+    def charge(self, tenant: Optional[str], chip_s: float,
+               *, job: Optional[str] = None) -> float:
+        """Burn ``chip_s`` chip-seconds against ``tenant``'s budget.
+
+        Returns the tenant's cumulative burn. Journals a durable
+        ``tenant_charge`` record (buffered append — the caller's next
+        group commit makes it durable, same contract as task_progress).
+        """
+        t = self.resolve(tenant)
+        with self._lock:
+            total = self._charged.get(t, 0.0) + float(chip_s)
+            self._charged[t] = total
+            jnl = self.journal
+            if jnl is not None:
+                jnl.append("tenant_charge", tenant=t,
+                           chip_s=round(float(chip_s), 6), job=job)
+        return total
+
+    def charged(self, tenant: Optional[str]) -> float:
+        with self._lock:
+            return self._charged.get(self.resolve(tenant), 0.0)
+
+    def budget_exhausted(self, tenant: Optional[str]) -> bool:
+        t = self.resolve(tenant)
+        with self._lock:
+            q = self._quotas.get(t, self._default)
+            if q.chip_seconds is None:
+                return False
+            return self._charged.get(t, 0.0) >= q.chip_seconds
+
+    def note_admit(self, tenant: Optional[str]) -> None:
+        t = self.resolve(tenant)
+        with self._lock:
+            self._admitted[t] = self._admitted.get(t, 0) + 1
+
+    def note_shed(self, tenant: Optional[str]) -> None:
+        t = self.resolve(tenant)
+        with self._lock:
+            self._shed[t] = self._shed.get(t, 0) + 1
+
+    # -- fair share -----------------------------------------------------
+
+    def fair_target(self, tenant: Optional[str],
+                    live_by_tenant: Mapping[str, int]) -> float:
+        """``tenant``'s weighted share of the currently-live job count.
+
+        Weights are taken over the tenants that are live right now plus
+        the queried tenant (an idle tenant's entitlement is computed as
+        if it joined): target_t = total_live * w_t / sum(w_active).
+        """
+        t = self.resolve(tenant)
+        with self._lock:
+            active = {self.resolve(k) for k, n in live_by_tenant.items()
+                      if n > 0}
+            active.add(t)
+            total = sum(int(n) for n in live_by_tenant.values() if n > 0)
+            wsum = sum(
+                self._quotas.get(a, self._default).weight for a in active
+            )
+            w = self._quotas.get(t, self._default).weight
+        if wsum <= 0.0:
+            return float(total)
+        return total * (w / wsum)
+
+    def over_fair_share(self, tenant: Optional[str],
+                        live_by_tenant: Mapping[str, int]) -> bool:
+        """True when ``tenant`` holds strictly more than its weighted
+        slice of the live jobs (pressure and shedding target it first)."""
+        t = self.resolve(tenant)
+        live = int(live_by_tenant.get(t, 0))
+        if live <= 0:
+            return False
+        return live > self.fair_target(t, live_by_tenant)
+
+    def fair_share_multiplier(self, tenant: Optional[str],
+                              live_by_tenant: Mapping[str, int]) -> float:
+        """Scale factor for the admission weight of ``tenant``'s next job.
+
+        ``(target + 1) / (live + 1)``: a tenant at its fair share gets
+        ~1.0, an over-share tenant's new work is deprioritized, an
+        under-share tenant's is boosted — clamped so deadlines and
+        priorities still dominate the solver objective.
+        """
+        t = self.resolve(tenant)
+        live = int(live_by_tenant.get(t, 0))
+        target = self.fair_target(t, live_by_tenant)
+        lo, hi = _FAIR_SHARE_CLAMP
+        return max(lo, min(hi, (target + 1.0) / (live + 1.0)))
+
+    def over_share_tenants(
+            self, live_by_tenant: Mapping[str, int]) -> set:
+        """Tenants currently over their weighted slice (shed these first)."""
+        return {self.resolve(t) for t, n in live_by_tenant.items()
+                if n > 0 and self.over_fair_share(t, live_by_tenant)}
+
+    # -- recovery -------------------------------------------------------
+
+    def restore(self, charges: Mapping[str, float]) -> None:
+        """Re-seat chip-second burn folded from ``tenant_charge`` records.
+
+        Replaces (not adds to) the in-memory counters: recovery replays
+        the whole journal, so the folded totals ARE the ground truth.
+        """
+        with self._lock:
+            for t, v in charges.items():
+                self._charged[self.resolve(t)] = float(v)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One JSON-able view per tenant (operator CLI / drain records)."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            names: Iterable[str] = set(self._quotas) | set(self._charged) \
+                | set(self._admitted) | set(self._shed)
+            for t in sorted(names):
+                q = self._quotas.get(t, self._default)
+                out[t] = {
+                    "admitted": self._admitted.get(t, 0),
+                    "shed": self._shed.get(t, 0),
+                    "charged_chip_s": round(self._charged.get(t, 0.0), 6),
+                    "chip_seconds_budget": q.chip_seconds,
+                    "max_live_jobs": q.max_live_jobs,
+                    "max_inflight": q.max_inflight,
+                    "weight": q.weight,
+                }
+            return out

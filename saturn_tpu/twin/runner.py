@@ -95,6 +95,12 @@ class CampaignConfig:
     dedup_every: int = 0                 # >0: every Nth job resubmits its
     #                                      predecessor's dedup key (retry
     #                                      storm: exercises idempotency)
+    tenant_mix: Optional[Dict[str, float]] = None  # tenant -> arrival
+    #                                      weight: synthesized arrivals are
+    #                                      tenant-tagged (noisy-neighbour
+    #                                      fairness campaigns); drawn from a
+    #                                      separate RNG stream, so historic
+    #                                      seeds replay draw for draw
     # ---- run control
     seed: int = 7
     max_intervals: int = 1000
@@ -123,6 +129,8 @@ class _Counters:
     verdicts: Dict[str, int] = field(default_factory=dict)
     tiers: Dict[str, int] = field(default_factory=dict)
     gateway_sheds: Dict[str, int] = field(default_factory=dict)
+    tenant_submitted: Dict[str, int] = field(default_factory=dict)
+    tenant_sheds: Dict[str, int] = field(default_factory=dict)
 
 
 def _shares(counts: Dict[str, int]) -> Dict[str, float]:
@@ -167,6 +175,7 @@ class TwinCampaign:
         self.recovered_dedup: Dict[str, str] = {}
         self.journal = None
         self.queue = None
+        self.tenancy = None  # twin runs tenant-tagged but unquota'd
 
     # ----------------------------------------------------------- arrivals
     def _build_arrivals(self) -> List[Tuple[float, dict]]:
@@ -191,6 +200,7 @@ class TwinCampaign:
         trace = arrival_stream(
             cfg.n_jobs, base_rate_hz=cfg.base_rate_hz,
             burst_rate_hz=cfg.burst_rate_hz, seed=cfg.seed,
+            tenant_mix=cfg.tenant_mix,
         )
         for arr in trace:
             name = f"twin-{arr.index:06d}"
@@ -206,6 +216,7 @@ class TwinCampaign:
                     "name": name, "total_batches": cfg.total_batches,
                     "priority": arr.priority, "deadline_s": cfg.deadline_s,
                     "max_retries": cfg.max_retries, "spec": None,
+                    "tenant": arr.tenant,
                 },
                 "dedup_key": key,
             }))
@@ -326,13 +337,17 @@ class TwinCampaign:
             self._next_arrival += 1
             self.clock.advance_to(max(self.clock.now(), at_s))
             arrival = time.monotonic()
+            tenant = frame["job"].get("tenant")
             try:
                 out = self.gateway._op_submit(dict(frame), self.cfg.session,
                                               arrival)
             except GatewayError as e:
                 c.gateway_sheds[e.code] = c.gateway_sheds.get(e.code, 0) + 1
+                if tenant is not None:
+                    c.tenant_sheds[tenant] = \
+                        c.tenant_sheds.get(tenant, 0) + 1
                 self._event("gateway_shed", name=frame["job"]["name"],
-                            code=e.code)
+                            code=e.code, tenant=tenant)
                 continue
             if out.get("duplicate"):
                 c.duplicates += 1
@@ -340,6 +355,9 @@ class TwinCampaign:
                             job=out["job_id"])
             else:
                 c.submitted += 1
+                if tenant is not None:
+                    c.tenant_submitted[tenant] = \
+                        c.tenant_submitted.get(tenant, 0) + 1
 
     def _arrivals_left(self) -> bool:
         return self._next_arrival < len(self._arrivals)
@@ -631,6 +649,10 @@ class TwinCampaign:
             "intervals": getattr(self, "_intervals", 0),
             "makespan_s": round(self.clock.now(), 6),
         }
+        if c.tenant_submitted or c.tenant_sheds:
+            ledger["tenant_submitted"] = dict(
+                sorted(c.tenant_submitted.items()))
+            ledger["tenant_sheds"] = dict(sorted(c.tenant_sheds.items()))
         with open(os.path.join(self.out_dir, "ledger.json"), "w") as fh:
             json.dump(ledger, fh, indent=1, sort_keys=True)
             fh.write("\n")

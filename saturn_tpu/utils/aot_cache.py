@@ -54,7 +54,16 @@ _SUBDIR = "aot"
 SCHEMA_VERSION = 1
 
 _stats_lock = threading.Lock()
-_stats = {"hits": 0, "misses": 0, "stores": 0, "errors": 0}
+_stats = {"hits": 0, "misses": 0, "stores": 0, "errors": 0,
+          "prewarms": 0, "warm_hits": 0}
+
+# In-process warm pool fed by the compile-ahead service
+# (``tenancy.compile_ahead``): executables compiled in the background
+# between admission and first dispatch. Same-process, so none of the
+# cross-context serialize/deserialize hazards apply — the warm pool is
+# consulted even when the on-disk cache is disabled (CPU default).
+_warm_lock = threading.Lock()
+_warm: dict = {}
 
 
 def stats() -> dict:
@@ -235,9 +244,17 @@ def load_or_compile(lowered: Any, devices: Any = None) -> Any:
     deserialized executable; ``utils.timing.hbm_bytes_required`` already
     degrades that to "feasible, with a warning".
     """
+    key = cache_key(lowered, devices)
+    if key is not None:
+        # Compile-ahead warm pool first: same process, no load hazard,
+        # works even where the disk cache is off (CPU default).
+        with _warm_lock:
+            warm = _warm.get(key)
+        if warm is not None:
+            _bump("warm_hits")
+            return warm
     if not enabled():
         return lowered.compile()
-    key = cache_key(lowered, devices)
     if key is None:
         return lowered.compile()
     hit = _load(key)
@@ -248,3 +265,27 @@ def load_or_compile(lowered: Any, devices: Any = None) -> Any:
     compiled = lowered.compile()
     _store(key, compiled)
     return compiled
+
+
+def prewarm(lowered: Any, devices: Any = None) -> Any:
+    """Compile ``lowered`` now and park the executable in the warm pool.
+
+    Called from compile-ahead worker threads. The executable goes two
+    places: the in-process warm pool (always — that is what makes the
+    admitted job's first ``load_or_compile`` free), and the on-disk
+    cache via the normal :func:`load_or_compile` path when enabled (so
+    the prewarm also survives a restart).
+    """
+    compiled = load_or_compile(lowered, devices)
+    key = cache_key(lowered, devices)
+    if key is not None:
+        with _warm_lock:
+            _warm[key] = compiled
+        _bump("prewarms")
+    return compiled
+
+
+def clear_warm() -> None:
+    """Drop the warm pool (tests; bounded-memory resets)."""
+    with _warm_lock:
+        _warm.clear()
