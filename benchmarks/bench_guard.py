@@ -942,6 +942,87 @@ def validate_tenant_row(row) -> list:
     return problems
 
 
+#: Required key -> type for the ``benchmarks/grow_defrag.py`` row. Same
+#: contract as the other ROW_REQUIRED tables: the bench self-validates
+#: before printing, and recorded rows can be re-checked without re-running.
+GROW_ROW_REQUIRED = {
+    "metric": str,               # "grow_defrag"
+    "drained": int,              # deferred jobs admitted after the wave, >= 1
+    "defrag_admitted": int,      # gangs the wave unblocked, >= 1
+    "moves": int,                # victim relocations executed
+    "grow_events": int,          # hysteresis-matured grow events surfaced
+    "migrations_done": int,      # two-phase moves that reached migration_done
+    "lost_jobs": int,            # unresolved intents + still-blocked, must be 0
+    "cap_bytes": int,
+    "need_bytes": int,
+    "wall_s": float,
+    "status": str,
+}
+
+
+def validate_grow_row(row) -> list:
+    """Schema-check one grow/defrag row; returns human-readable problems
+    (empty list = valid).
+
+    Enforces the elastic scale-up acceptance bars: the wave actually
+    unblocked a gang (defrag_admitted >= 1) and the backlog drained
+    (drained >= 1) with nothing lost — every journaled ``migration_intent``
+    reached a ``migration_done``/``migration_rollback`` and no gang stayed
+    blocked (lost_jobs == 0)."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in GROW_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass  # whole-number float serialized as int is fine
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "grow_defrag":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'grow_defrag'"
+        )
+    dr = row.get("drained")
+    if isinstance(dr, int) and not isinstance(dr, bool) and dr < 1:
+        problems.append(
+            f"drained {dr} < 1 (the DEFER backlog never drained — the "
+            "occupancy gate stayed closed after the wave)"
+        )
+    da = row.get("defrag_admitted")
+    if isinstance(da, int) and not isinstance(da, bool) and da < 1:
+        problems.append(
+            f"defrag_admitted {da} < 1 (the wave planned no gang admission)"
+        )
+    lj = row.get("lost_jobs")
+    if isinstance(lj, int) and not isinstance(lj, bool) and lj != 0:
+        problems.append(
+            f"lost_jobs {lj} != 0 (a migration intent never closed, or a "
+            "gang stayed blocked after the wave)"
+        )
+    return problems
+
+
+def grow_defrag_errors() -> list:
+    """Run the hardware-free grow/defrag bench and validate its row.
+
+    Cheap (<1s, no JAX): the real monitor, occupancy gate, defrag planner
+    and two-phase journal drive a scripted heal-and-compact loop. A
+    scheduling or durability change that stops the backlog draining — or
+    leaves a migration intent unresolved — fails the guard here."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import grow_defrag
+
+    row = grow_defrag.run()
+    return validate_grow_row(row)
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (
@@ -1034,6 +1115,20 @@ def main() -> int:
         print(json.dumps({
             "metric": "bench_guard", "status": "twin_regression",
             "value": new.get("value"), "diagnostics": tw_errors,
+        }))
+        return 1
+    try:
+        gd_errors = grow_defrag_errors()
+    except Exception as e:
+        gd_errors = [f"grow/defrag bench unavailable: "
+                     f"{type(e).__name__}: {e}"]
+    if gd_errors:
+        # Same refusal for the recovery path: the row was measured by a
+        # control plane whose grow/defrag loop lost work or left a
+        # migration intent unresolved.
+        print(json.dumps({
+            "metric": "bench_guard", "status": "grow_defrag_failed",
+            "value": new.get("value"), "diagnostics": gd_errors,
         }))
         return 1
     out = {

@@ -1,0 +1,177 @@
+"""Elastic scale-up + defrag wave: drain a blocked gang, hardware-free.
+
+Scripted deterministic mini-loop over the real recovery components — no
+JAX, no solver, no threads:
+
+1. a degraded fleet (half the devices lost) heals: the real
+   ``FleetHealthMonitor`` surfaces the ``grow`` event through its
+   hysteresis gate and the ``GrowCoordinator`` journals it;
+2. a deferred gang's HBM footprint (``need`` bytes per device) fits no
+   block because two running tasks pin live state on opposite halves of
+   the ring — the coordinator's occupancy gate says ``fits: False``;
+3. ``plan_defrag_wave`` compacts the pinned tasks (victim relocation with
+   headroom checks) and ``execute_wave`` runs the moves through the
+   two-phase ``migration_intent``/``migration_done`` journal;
+4. the gate flips to ``fits: True`` — the gang drains — and the journal
+   is re-folded (the same fold ``analysis grow`` uses) to prove every
+   intent closed: ``lost_jobs`` counts unresolved intents, so 0 means a
+   crash replay would have nothing left open either.
+
+Prints ONE JSON line like ``bench.py``:
+
+    {"metric": "grow_defrag", "drained": 1, "defrag_admitted": 1,
+     "moves": 1, "lost_jobs": 0, ...}
+
+``bench_guard.validate_grow_row`` enforces drained >= 1,
+defrag_admitted >= 1 and lost_jobs == 0. Run:
+``python benchmarks/grow_defrag.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+#: Modeled per-device HBM capacity (bytes). Small round numbers keep the
+#: arithmetic legible: live tasks pin 60 B/device, the gang needs 80.
+CAP_BYTES = 100
+PIN_BYTES = 60
+NEED_BYTES = 80
+
+
+class FakeDev:
+    process_index = 0
+
+
+class FakeTask:
+    """Minimal task surface the recovery components touch."""
+
+    def __init__(self, name, sizes, resident=0):
+        self.name = name
+        self._sizes = tuple(sizes)
+        self.resident_bytes = resident
+        self._live_state = object() if resident else None
+        self.hints = {}
+        self.released = 0
+
+    def feasible_strategies(self):
+        return list(self._sizes)
+
+    def release_live_state(self):
+        self._live_state = None
+        self.released += 1
+
+
+class FakePlan:
+    def __init__(self, assignments):
+        self.assignments = assignments
+
+
+class _Slot:
+    def __init__(self, block):
+        self.block = block
+
+
+def run() -> dict:
+    from saturn_tpu.analysis.cli import _fold_grow_records
+    from saturn_tpu.core.mesh import Block, SliceTopology
+    from saturn_tpu.durability import journal as jmod
+    from saturn_tpu.resilience import FleetHealthMonitor, GrowCoordinator
+
+    t0 = time.time()
+    topo = SliceTopology([FakeDev() for _ in range(8)], slice_size=8)
+
+    # Two running tasks pin live state on opposite halves of the ring;
+    # the deferred gang needs a 4-device block with NEED_BYTES headroom.
+    live1 = FakeTask("live-a", (2,), resident=PIN_BYTES)
+    live2 = FakeTask("live-b", (2,), resident=PIN_BYTES)
+    gang = FakeTask("gang-big", (4,), resident=NEED_BYTES)
+    plan = FakePlan({
+        "live-a": _Slot(Block(0, 2)),
+        "live-b": _Slot(Block(4, 2)),
+    })
+    live = [live1, live2]
+
+    out_dir = tempfile.mkdtemp(prefix="grow_defrag_")
+    jnl = jmod.Journal(out_dir)
+    # cap_bytes pinned on the coordinator, NOT via SATURN_TPU_HBM_BYTES —
+    # mutating the process env here would poison bench_guard's memlens
+    # gate running later in the same process.
+    coord = GrowCoordinator(journal=jnl, poll_every=0, cap_bytes=CAP_BYTES)
+    gate = coord.occupancy_gate(lambda: live + [gang], lambda: plan)
+
+    # 1. the fleet heals: shrink consumed earlier, the return matures
+    # through the hysteresis gate and surfaces as a grow.
+    mon = FleetHealthMonitor(8, grow_hysteresis=1)
+    mon.mark_lost([4, 5, 6, 7], cause="slice_preemption")
+    assert mon.poll().kind == "shrink"
+    mon.mark_restored([4, 5, 6, 7])
+    change = mon.poll()
+    assert change is not None and change.kind == "grow"
+    grow_events = 1
+    coord.note_grow(change, interval_index=1, n_deferred=1,
+                    capacity=topo.capacity)
+
+    # 2. occupancy blocks the gang even after the grow.
+    before = gate(gang, topo)
+    assert before is not None and before["fits"] is False
+
+    # 3. plan + execute the defrag wave (two-phase journaled moves).
+    wave = coord.plan_wave([gang], live, topo, plan)
+    wave_id = coord.execute_wave(
+        wave, {t.name: t for t in live}, interval_index=1,
+        publish_fn=lambda task: True,
+    )
+    for mv in wave.moves:
+        plan.assignments[mv.task] = _Slot(Block(*mv.to_block))
+
+    # 4. the gate flips; the gang drains.
+    after = gate(gang, topo)
+    drained = 1 if (after is None or after["fits"]) else 0
+    if drained:
+        coord.note_drained([gang.name], interval_index=1, trigger="grow")
+    jnl.close()
+
+    folded = _fold_grow_records(jmod.replay(out_dir))
+    lost_jobs = len(folded["unresolved_intents"]) + len(wave.still_blocked)
+    row = {
+        "metric": "grow_defrag",
+        "drained": drained,
+        "defrag_admitted": len(wave.admitted),
+        "moves": len(wave.moves),
+        "released_live_states": sum(t.released for t in live),
+        "grow_events": grow_events,
+        "journaled_grow_events": len(folded["grow_events"]),
+        "migrations_done": folded["migrations"]["done"],
+        "lost_jobs": lost_jobs,
+        "wave": wave_id,
+        "cap_bytes": CAP_BYTES,
+        "need_bytes": NEED_BYTES,
+        "wall_s": round(time.time() - t0, 6),
+        "status": "ok" if (drained and wave.admitted and not lost_jobs)
+                  else "blocked",
+    }
+    return row
+
+
+def main() -> int:
+    row = run()
+    from bench_guard import validate_grow_row
+
+    problems = validate_grow_row(row)
+    if problems:
+        row["status"] = "invalid"
+        row["problems"] = problems
+    print(json.dumps(row, sort_keys=True))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

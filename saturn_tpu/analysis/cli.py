@@ -337,8 +337,10 @@ def _cmd_tenancy(args: argparse.Namespace) -> int:
                 if (hits + misses) > 0 else None)
     for r in tenants.values():
         r["charged_chip_s"] = round(r["charged_chip_s"], 6)
+    backlog = _fold_grow_records(records)["backlog"]
     payload = {
         "tenants": {t: tenants[t] for t in sorted(tenants)},
+        "backlog": backlog,
         "lease": {
             "records": len(leases),
             "current_epoch": current_epoch,
@@ -368,6 +370,10 @@ def _cmd_tenancy(args: argparse.Namespace) -> int:
                 f"{k}x{n}" for k, n in sorted(r["sheds"].items())))
         if r["charged_chip_s"]:
             bits.append(f"burned {r['charged_chip_s']:g} chip-s")
+        if t in backlog:
+            b = backlog[t]
+            bits.append(f"backlog {len(b['jobs'])} job(s), oldest "
+                        f"{b['oldest_age_s']:g}s")
         print(f"{t}: " + "; ".join(bits))
     if leases:
         print(f"lease: epoch {current_epoch} held by "
@@ -384,6 +390,165 @@ def _cmd_tenancy(args: argparse.Namespace) -> int:
         print("LEASE FENCING VIOLATIONS:")
         for v in violations:
             print(f"  {v}")
+        return 1
+    return 0
+
+
+def _fold_grow_records(records) -> dict:
+    """Fold journaled elastic scale-up records into the ``grow`` payload.
+
+    Shared by ``analysis grow`` (full view) and ``analysis tenancy``
+    (per-tenant backlog summary). Exit-status-relevant field:
+    ``unresolved_intents`` — migration intents with neither a ``done`` nor
+    a ``rollback``, i.e. moves a crash left open that recovery never
+    closed.
+    """
+    from saturn_tpu.service.admission import DEFER
+
+    grow_events: list = []
+    drains: list = []
+    waves: list = []
+    intents: dict = {}       # (wave, task) -> intent data
+    migrations = {"done": 0, "rolled_back": 0, "recovered_done": 0,
+                  "recovered_rollback": 0}
+    deferred: dict = {}      # job -> live backlog entry
+    drained_jobs = 0
+    last_ts = 0.0
+    for rec in records:
+        kind, d = rec["kind"], rec.get("data", {})
+        last_ts = max(last_ts, float(rec.get("ts", 0.0)))
+        if kind == "grow_event":
+            grow_events.append({
+                "interval": d.get("interval"),
+                "gained": d.get("gained", []),
+                "cause": d.get("cause", ""),
+                "n_deferred": d.get("n_deferred", 0),
+                "n_parked": d.get("n_parked", 0),
+                "unbenched": d.get("unbenched", []),
+            })
+        elif kind == "backlog_drain":
+            jobs = list(d.get("jobs", []))
+            drained_jobs += len(jobs)
+            drains.append({"interval": d.get("interval"), "jobs": jobs,
+                           "trigger": d.get("trigger", "")})
+        elif kind == "defrag_wave":
+            waves.append({
+                "wave": d.get("wave"), "interval": d.get("interval"),
+                "moves": d.get("moves", []),
+                "rolled_back": d.get("rolled_back", []),
+                "admitted": sorted(d.get("admitted", {})),
+                "still_blocked": d.get("still_blocked", []),
+            })
+        elif kind == "migration_intent":
+            intents[(d.get("wave"), d.get("task"))] = {
+                "wave": d.get("wave"), "task": d.get("task"),
+                "interval": d.get("interval"),
+                "from": d.get("from"), "to": d.get("to"),
+            }
+        elif kind == "migration_done":
+            intents.pop((d.get("wave"), d.get("task")), None)
+            migrations["done"] += 1
+            if d.get("recovered"):
+                migrations["recovered_done"] += 1
+        elif kind == "migration_rollback":
+            intents.pop((d.get("wave"), d.get("task")), None)
+            migrations["rolled_back"] += 1
+            if d.get("recovered"):
+                migrations["recovered_rollback"] += 1
+        elif kind == "job_deferred":
+            deferred[d.get("job")] = {
+                "task": d.get("task"), "tenant": d.get("tenant"),
+                "reason": d.get("reason", ""),
+                "revisit_on": d.get("revisit_on", ""),
+                "at": float(d.get("at", rec.get("ts", 0.0)) or 0.0),
+            }
+        elif kind == "job_admission":
+            if d.get("decision") != DEFER:
+                deferred.pop(d.get("job"), None)
+
+    backlog: dict = {}       # tenant -> summary of still-deferred jobs
+    for job, e in deferred.items():
+        t = e["tenant"] or "default"
+        row = backlog.setdefault(t, {
+            "jobs": [], "oldest_age_s": 0.0, "revisit_on": {}})
+        row["jobs"].append(job)
+        age = max(0.0, last_ts - e["at"]) if e["at"] else 0.0
+        row["oldest_age_s"] = round(max(row["oldest_age_s"], age), 6)
+        r = e["revisit_on"] or "?"
+        row["revisit_on"][r] = row["revisit_on"].get(r, 0) + 1
+    for row in backlog.values():
+        row["jobs"].sort()
+    return {
+        "grow_events": grow_events,
+        "backlog_drains": drains,
+        "drained_jobs": drained_jobs,
+        "defrag_waves": waves,
+        "migrations": migrations,
+        "unresolved_intents": [
+            intents[k] for k in sorted(intents, key=lambda k: (
+                str(k[0]), str(k[1])))
+        ],
+        "backlog": {t: backlog[t] for t in sorted(backlog)},
+    }
+
+
+def _cmd_grow(args: argparse.Namespace) -> int:
+    from saturn_tpu.durability import journal as jmod
+
+    try:
+        records = list(jmod.replay(args.path))
+    except OSError as e:
+        print(f"cannot replay journal at {args.path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    payload = _fold_grow_records(records)
+    unresolved = payload["unresolved_intents"]
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+        return 1 if unresolved else 0
+    if not (payload["grow_events"] or payload["backlog_drains"]
+            or payload["defrag_waves"] or payload["backlog"] or unresolved):
+        print(f"{args.path}: no elastic scale-up records in the journal")
+        return 0
+    for g in payload["grow_events"]:
+        bits = [f"gained {g['gained']}"]
+        if g["cause"]:
+            bits.append(g["cause"])
+        if g["n_deferred"]:
+            bits.append(f"{g['n_deferred']} deferred at the time")
+        if g["n_parked"]:
+            bits.append(f"{g['n_parked']} parked re-admitted")
+        if g["unbenched"]:
+            bits.append("unbenched " + ", ".join(g["unbenched"]))
+        print(f"grow @ interval {g['interval']}: " + "; ".join(bits))
+    for dr in payload["backlog_drains"]:
+        print(f"drain @ interval {dr['interval']} ({dr['trigger']}): "
+              + ", ".join(dr["jobs"]))
+    for w in payload["defrag_waves"]:
+        print(f"defrag {w['wave']} @ interval {w['interval']}: "
+              f"{len(w['moves'])} move(s), "
+              f"unblocked {w['admitted']}"
+              + (f", rolled back {w['rolled_back']}"
+                 if w["rolled_back"] else "")
+              + (f", still blocked {w['still_blocked']}"
+                 if w["still_blocked"] else ""))
+    m = payload["migrations"]
+    if m["done"] or m["rolled_back"]:
+        print(f"migrations: {m['done']} done "
+              f"({m['recovered_done']} via recovery), "
+              f"{m['rolled_back']} rolled back "
+              f"({m['recovered_rollback']} via recovery)")
+    for t, row in payload["backlog"].items():
+        mix = ", ".join(f"{k}x{n}" for k, n in sorted(
+            row["revisit_on"].items()))
+        print(f"backlog[{t}]: {len(row['jobs'])} job(s), oldest "
+              f"{row['oldest_age_s']:g}s ({mix}): "
+              + ", ".join(row["jobs"]))
+    if unresolved:
+        print("UNRESOLVED MIGRATION INTENTS (recovery never closed):")
+        for it in unresolved:
+            print(f"  {it['wave']}/{it['task']} "
+                  f"@ interval {it['interval']}")
         return 1
     return 0
 
@@ -992,6 +1157,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     tn.add_argument("path")
     tn.set_defaults(fn=_cmd_tenancy)
+
+    gr = sub.add_parser(
+        "grow",
+        help="summarize journaled elastic scale-up records: grow events, "
+             "backlog drains, defrag waves, migration intent/done pairing, "
+             "per-tenant DEFER backlog age (exit 1 on unresolved intents)",
+    )
+    gr.add_argument("path")
+    gr.set_defaults(fn=_cmd_grow)
 
     c = sub.add_parser(
         "concurrency",

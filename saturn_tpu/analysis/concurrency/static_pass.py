@@ -1344,6 +1344,7 @@ AUDITED_PATHS: Tuple[str, ...] = (
     "saturn_tpu/data",
     "saturn_tpu/health",
     "saturn_tpu/tenancy",
+    "saturn_tpu/resilience",
     "saturn_tpu/utils/metrics.py",
 )
 

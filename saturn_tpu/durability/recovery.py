@@ -95,9 +95,54 @@ class ServiceRecovery:
     #: compile_ahead event status -> count (requested/ready/error/hit/miss)
     #: — the durable half of the compile-ahead hit/miss ledger.
     compile_ahead: Dict[str, int] = field(default_factory=dict)
+    #: Defrag-wave two-phase migration ledger: (wave, task) -> intent record
+    #: for every ``migration_intent`` that never saw a ``migration_done`` /
+    #: ``migration_rollback``. The restarting service closes each exactly
+    #: once: resume (done) iff a ``ckpt_published`` for the task landed
+    #: *after* the intent, else roll back.
+    pending_migrations: Dict[Any, dict] = field(default_factory=dict)
+    migrations_done: int = 0
+    migrations_rolled_back: int = 0
+    #: Grow-path counters folded from grow_event / backlog_drain records.
+    grow_events: int = 0
+    backlog_drained: int = 0
+    #: Highest wave sequence number seen in any wave-bearing record
+    #: (``wave-<interval>-<seq>``): the restarting coordinator seeds its
+    #: sequence past this so wave ids never collide across incarnations
+    #: (the interval counter alone restarts from zero). Folded from
+    #: ``migration_intent`` too, not just the ``defrag_wave`` summary —
+    #: a kill mid-wave dies before the summary lands.
+    defrag_waves: int = 0
+    #: job_id -> latest job_deferred record (left for visibility even after
+    #: the job admits; admission drops pool entries live, the journal view
+    #: keeps history).
+    deferred: Dict[str, dict] = field(default_factory=dict)
+    #: task -> seq of its newest ckpt_published record (resume/rollback
+    #: arbitration for pending migrations).
+    last_ckpt_seq: Dict[str, int] = field(default_factory=dict)
 
     def live_jobs(self) -> List[JobReplay]:
         return [j for j in self.jobs.values() if not j.terminal]
+
+    def resolve_pending_migrations(self):
+        """Split unclosed migration intents into (resume, rollback) lists.
+
+        A move whose victim's checkpoint was durably published *after* the
+        intent record is safe to close as done — the state the move needed
+        on disk is there; everything else rolls back (device-resident live
+        state died with the process either way, so rollback is a pure
+        journal closure: the next restore reads the last checkpoint). The
+        caller journals one ``migration_done`` / ``migration_rollback``
+        per entry — exactly once, because closed intents never re-enter
+        ``pending_migrations`` on the next replay.
+        """
+        resume, rollback = [], []
+        for (wave, task), rec in sorted(self.pending_migrations.items()):
+            if self.last_ckpt_seq.get(task, -1) > rec["seq"]:
+                resume.append(rec)
+            else:
+                rollback.append(rec)
+        return resume, rollback
 
 
 @dataclass
@@ -154,6 +199,15 @@ def fold_health_record(
     return True
 
 
+def _wave_seq(wave_id: str) -> int:
+    """Trailing sequence number of a ``wave-<interval>-<seq>`` id (0 when
+    the id doesn't parse — foreign or hand-written journals stay legible)."""
+    try:
+        return int(str(wave_id).rsplit("-", 1)[-1])
+    except (TypeError, ValueError):
+        return 0
+
+
 def replay_service_state(root: str) -> ServiceRecovery:
     """Fold the durable journal into the service's recovery state.
 
@@ -208,6 +262,31 @@ def replay_service_state(root: str) -> ServiceRecovery:
         elif kind == "ckpt_published":
             task = d.get("task") or d.get("path", "")
             state.checkpoints.setdefault(task, []).append(d.get("path", ""))
+            state.last_ckpt_seq[task] = rec["seq"]
+        elif kind == "migration_intent":
+            key = (d.get("wave", ""), d.get("task", ""))
+            state.pending_migrations[key] = dict(d, seq=rec["seq"])
+            state.defrag_waves = max(state.defrag_waves,
+                                     _wave_seq(d.get("wave", "")))
+        elif kind == "migration_done":
+            state.pending_migrations.pop(
+                (d.get("wave", ""), d.get("task", "")), None)
+            state.migrations_done += 1
+        elif kind == "migration_rollback":
+            state.pending_migrations.pop(
+                (d.get("wave", ""), d.get("task", "")), None)
+            state.migrations_rolled_back += 1
+        elif kind == "grow_event":
+            state.grow_events += 1
+        elif kind == "backlog_drain":
+            state.backlog_drained += len(d.get("jobs", ()))
+        elif kind == "job_deferred":
+            state.deferred[d.get("job", "")] = dict(d)
+        elif kind == "defrag_wave":
+            # The per-move ledger above is authoritative for closure; the
+            # summary only advances the cross-incarnation wave sequence.
+            state.defrag_waves = max(state.defrag_waves,
+                                     _wave_seq(d.get("wave", "")))
         elif kind == "gateway_lease":
             epoch = int(d.get("epoch", 0))
             owner = d.get("owner")

@@ -604,6 +604,39 @@ def _orchestrate_loop(
                         faults.apply_due(interval_index, health)
                     change = health.poll()
                     if change is not None and change.kind in ("shrink", "grow"):
+                        if change.kind == "grow" and journal is not None:
+                            journal.log(
+                                "grow_event", interval=interval_index,
+                                gained=list(change.gained),
+                                cause=change.cause,
+                                n_parked=len(parked),
+                                capacity=base_topo.capacity,
+                            )
+                        if change.kind == "grow" and parked:
+                            # Elastic scale-up: fresh capacity runs parked
+                            # work NOW — short-circuit remaining backoff
+                            # (streak ledgers untouched) and fold the parked
+                            # tasks into the replan set so the grow re-solve
+                            # covers live ∪ parked.
+                            if guardian is not None:
+                                guardian.unbench_all(cause="grow")
+                            names_back = sorted(t.name for t in parked)
+                            task_list.extend(parked)
+                            parked = []
+                            if journal is not None:
+                                journal.append(
+                                    "backlog_drain",
+                                    interval=interval_index,
+                                    jobs=names_back, trigger="grow",
+                                )
+                            metrics.event(
+                                "backlog_drain", interval=interval_index,
+                                jobs=names_back, trigger="grow",
+                            )
+                            logger.info(
+                                "grow: re-admitted parked %s ahead of "
+                                "backoff", names_back,
+                            )
                         task_list, topo, plan = _handle_topology_change(
                             task_list, base_topo, health, replanner, change,
                             plan, tlimit, all_failed,

@@ -332,6 +332,27 @@ class TrainingGuardian:
         with self._mu:
             return self._benched.get(name)
 
+    def unbench_all(self, cause: str = "grow") -> Tuple[str, ...]:
+        """Short-circuit every remaining backoff window (grow event: fresh
+        capacity should run parked work *now*, not ``ceil(backoff)``
+        intervals later). The consecutive-fault streak ledgers are
+        deliberately untouched — the next fault of a flaky task still sees
+        its full history and backs off harder, exactly as if the bench had
+        expired naturally."""
+        with self._mu:
+            released = tuple(sorted(self._benched))
+            self._benched.clear()
+        for name in released:
+            metrics.event(
+                "health", code=HEALTH_EVENT_CODES["backoff"], task=name,
+                cause=cause, unbenched=True,
+            )
+        if released:
+            self._journal(
+                "health_unbench", tasks=list(released), cause=cause,
+            )
+        return released
+
     # ------------------------------------------------------------- recovery
     def restore(
         self,

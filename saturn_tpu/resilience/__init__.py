@@ -14,7 +14,10 @@ the orchestrator's fixed-topology interval loop into an elastic one:
 - :mod:`saturn_tpu.resilience.replan` — on a shrink/grow event, diffs the
   ``SliceTopology``, re-invokes the SPASE solver over the surviving mesh
   (Amdahl-interpolating never-profiled sizes) under a pluggable recovery
-  policy.
+  policy; :func:`plan_defrag_wave` plans occupancy-driven compaction.
+- :mod:`saturn_tpu.resilience.grow` — the recovery half: grow-event
+  handling (unbench parked work, drain the DEFER backlog) and two-phase
+  journaled defragmentation waves.
 
 Cross-mesh checkpoint migration (restoring a task's state onto a mesh of a
 different shape than it was saved under) lives in
@@ -43,6 +46,7 @@ from saturn_tpu.resilience.faults import (
     PreemptedError,
     seeded_schedule,
 )
+from saturn_tpu.resilience.grow import GrowCoordinator, default_resident_bytes
 from saturn_tpu.resilience.health import DeviceHealth, FleetHealthMonitor, TopologyChange
 from saturn_tpu.resilience.netchaos import (
     NET_FAULT_CLASSES,
@@ -51,9 +55,20 @@ from saturn_tpu.resilience.netchaos import (
     NetChaosStats,
     single_fault_spec,
 )
-from saturn_tpu.resilience.replan import RECOVERY_POLICIES, ElasticReplanner
+from saturn_tpu.resilience.replan import (
+    RECOVERY_POLICIES,
+    DefragMove,
+    DefragWave,
+    ElasticReplanner,
+    plan_defrag_wave,
+)
 
 __all__ = [
+    "GrowCoordinator",
+    "default_resident_bytes",
+    "DefragMove",
+    "DefragWave",
+    "plan_defrag_wave",
     "KILL_POINTS",
     "CrashInjector",
     "SimulatedKill",

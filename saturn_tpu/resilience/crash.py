@@ -68,6 +68,17 @@ KILL_POINTS = (
     "mid-shard-write",
     "pre-manifest-rename",
     "fused.unfuse",
+    # Defrag-wave migration two-phase points (resilience/grow.py): crossed
+    # between a move's ``migration_intent`` and ``migration_done`` journal
+    # records. ``defrag.pre-publish`` = intent durable, destination
+    # checkpoint not yet published (replay rolls the move back);
+    # ``defrag.pre-commit`` = checkpoint published, done record buffered but
+    # not fsynced (replay resumes the move from the published checkpoint);
+    # ``defrag.post-commit`` = done record durable (replay is a no-op).
+    # Each outcome must land exactly once with the iteration ledger intact.
+    "defrag.pre-publish",
+    "defrag.pre-commit",
+    "defrag.post-commit",
 )
 
 

@@ -1,0 +1,337 @@
+"""Elastic scale-up: recovery half of the elastic path (grow + defrag).
+
+The shrink half (PR 2's :class:`~saturn_tpu.resilience.replan.
+ElasticReplanner`) degrades gracefully — evict, degrade, pause. This module
+makes the fleet recover *aggressively*: on every ``grow``
+:class:`~saturn_tpu.resilience.health.TopologyChange` (and on a periodic
+opportunistic poll), the :class:`GrowCoordinator`
+
+1. journals a durable ``grow_event`` record (operator view:
+   ``python -m saturn_tpu.analysis grow``),
+2. short-circuits guardian backoff benches (``unbench_all`` — the fault
+   streak ledger stays intact) so parked work restarts *this* interval,
+3. exposes the DEFER backlog so the caller's re-solve spans
+   live ∪ deferred ∪ parked jobs, journaling a ``backlog_drain`` record
+   when previously-deferred work admits, and
+4. when a deferred gang *still* can't fit — the schedule has room but
+   other tasks' device-resident live state pins too much HBM — plans a
+   **defragmentation wave** (:func:`~saturn_tpu.resilience.replan.
+   plan_defrag_wave`) and executes it move by move through the existing
+   checkpoint-migration path.
+
+Every move is journaled two-phase: a durable ``migration_intent`` before
+any state changes, a ``migration_done`` after the victim's checkpoint is
+verified durable and its live state released. A kill mid-wave therefore
+resolves exactly-once on replay: intent + a later ``ckpt_published`` ⇒
+resume (the state safely landed; recovery closes the move as done);
+intent alone ⇒ roll back (nothing was released that a fresh restore from
+the last checkpoint doesn't cover). Kill-points ``defrag.pre-publish`` /
+``defrag.pre-commit`` / ``defrag.post-commit`` arm the crash harness
+between the phases.
+
+Saturn itself (arXiv 2311.02840) re-solves on its introspection interval
+but never *re-expands* — a preempted resource stays lost to the batch.
+This subsystem is the parity delta: the DEFER pool stops being a waiting
+room and becomes a backlog the system actively drains.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from saturn_tpu.resilience.replan import DefragWave, plan_defrag_wave
+from saturn_tpu.utils import metrics
+
+log = logging.getLogger("saturn_tpu")
+
+#: Env knob: run the opportunistic defrag poll every N intervals even
+#: without a grow event (0 disables the periodic poll).
+ENV_GROW_POLL = "SATURN_TPU_GROW_POLL"
+DEFAULT_GROW_POLL = 8
+
+
+def default_resident_bytes(task: Any) -> int:
+    """Per-device bytes a task's live state pins between intervals.
+
+    Convention mirrors memlens: unknown ⇒ 0 ⇒ the occupancy gate fails
+    open. Tasks (and tests/benches) can declare the figure via a
+    ``resident_bytes`` attribute or hint; a task with no device-resident
+    live state pins nothing regardless.
+    """
+    if getattr(task, "_live_state", "absent") is None:
+        return 0
+    v = getattr(task, "resident_bytes", None)
+    if v is None:
+        hints = getattr(task, "hints", None)
+        if isinstance(hints, dict):
+            v = hints.get("resident_bytes")
+    try:
+        return max(0, int(v or 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+class GrowCoordinator:
+    """Drives grow-event recovery for one control loop (orchestrator,
+    service, or twin). Single-threaded by design — only the owning loop
+    calls it, mirroring :class:`~saturn_tpu.service.admission.
+    AdmissionController`."""
+
+    def __init__(
+        self,
+        journal: Any = None,
+        poll_every: Optional[int] = None,
+        resident_bytes: Callable[[Any], int] = default_resident_bytes,
+        cap_bytes: Optional[int] = None,
+    ):
+        self.journal = journal
+        if poll_every is None:
+            poll_every = int(os.environ.get(ENV_GROW_POLL, DEFAULT_GROW_POLL))
+        self.poll_every = max(0, poll_every)
+        self.resident_bytes = resident_bytes
+        self._cap_bytes = cap_bytes
+        self._wave_seq = 0
+        self._last_grow_interval: Optional[int] = None
+
+    def seed_wave_seq(self, past: int) -> None:
+        """Advance the wave sequence past a recovered journal's highest
+        (``ServiceRecovery.defrag_waves``) so ids stay unique across
+        incarnations — the interval half of the id restarts from zero."""
+        # sanctioned-unlocked: coordinator is single-threaded by design —
+        # seeded once during recovery, before the owning loop starts
+        self._wave_seq = max(self._wave_seq, int(past))
+
+    # ------------------------------------------------------------- capacity
+    def _capacity_bytes(self, topology) -> int:
+        if self._cap_bytes is not None:
+            return self._cap_bytes
+        try:
+            from saturn_tpu.analysis.memlens import passes as ml_passes
+            return ml_passes.hbm_capacity_bytes(topology.devices)
+        except Exception:
+            return 0
+
+    # ----------------------------------------------------------- grow event
+    def note_grow(self, change, interval_index: int, *, guardian=None,
+                  n_deferred: int = 0, n_parked: int = 0,
+                  capacity: int = 0) -> List[str]:
+        """Record a surfaced grow event and short-circuit every guardian
+        bench. Returns the names released from backoff (streak ledgers
+        untouched — see ``FleetGuardian.unbench_all``)."""
+        self._last_grow_interval = interval_index
+        released: List[str] = []
+        if guardian is not None and hasattr(guardian, "unbench_all"):
+            released = list(guardian.unbench_all(cause="grow"))
+        n_parked = max(n_parked, len(released))
+        if self.journal is not None:
+            self.journal.log(
+                "grow_event", interval=interval_index,
+                gained=list(getattr(change, "gained", ()) or ()),
+                cause=getattr(change, "cause", ""),
+                capacity=capacity, n_deferred=n_deferred,
+                n_parked=n_parked, unbenched=released,
+            )
+        metrics.event(
+            "grow_event", interval=interval_index,
+            gained=list(getattr(change, "gained", ()) or ()),
+            n_deferred=n_deferred, n_parked=n_parked, unbenched=released,
+        )
+        return released
+
+    def note_drained(self, jobs: Sequence[str], interval_index: int,
+                     trigger: str = "grow") -> None:
+        """Journal that previously-deferred jobs admitted this interval."""
+        if not jobs:
+            return
+        if self.journal is not None:
+            self.journal.append(
+                "backlog_drain", interval=interval_index,
+                jobs=sorted(jobs), trigger=trigger,
+            )
+        metrics.event(
+            "backlog_drain", interval=interval_index,
+            jobs=sorted(jobs), trigger=trigger,
+        )
+
+    # -------------------------------------------------------------- polling
+    def defrag_due(self, interval_index: int, grew: bool) -> bool:
+        """Should this interval attempt a defrag wave? On every grow, and
+        opportunistically every ``poll_every`` intervals (a completion may
+        have freed HBM without any topology change)."""
+        if grew:
+            return True
+        return self.poll_every > 0 and interval_index > 0 and (
+            interval_index % self.poll_every == 0
+        )
+
+    # ------------------------------------------------------- occupancy gate
+    def occupancy_gate(
+        self,
+        live_tasks: Callable[[], Sequence],
+        current_plan: Callable[[], Any],
+        ) -> Callable:
+        """Build the admission occupancy gate (see ``AdmissionController.
+        occupancy_gate``): verdict on whether an arrival's HBM footprint
+        fits around the pinned live state of running tasks. Fail-open
+        everywhere information is missing."""
+
+        def gate(task, topology) -> Optional[dict]:
+            cap = self._capacity_bytes(topology)
+            if cap <= 0:
+                return None
+            plan = current_plan()
+            if plan is None:
+                return None
+            occ: Dict[int, int] = {}
+            for t in live_tasks():
+                if t.name == getattr(task, "name", None):
+                    continue
+                b = self.resident_bytes(t)
+                a = plan.assignments.get(t.name)
+                if b <= 0 or a is None:
+                    continue
+                for i in range(a.block.offset, a.block.end):
+                    occ[i] = occ.get(i, 0) + b
+            if not occ:
+                return None  # nothing pinned: occupancy cannot block
+            need = self._need_bytes(task, topology, cap)
+            if need <= 0:
+                return None
+            best_free = 0
+            for g in sorted(
+                    (g for g in task.feasible_strategies()
+                     if g <= topology.capacity), reverse=True):
+                for blk in topology.blocks(g):
+                    used = max(
+                        occ.get(i, 0) for i in range(blk.offset, blk.end)
+                    )
+                    free = cap - used
+                    best_free = max(best_free, free)
+                    if free >= need:
+                        return {"fits": True, "free_bytes": free,
+                                "need_bytes": need}
+            return {"fits": False, "free_bytes": best_free,
+                    "need_bytes": need}
+
+        return gate
+
+    def _need_bytes(self, task, topology, cap: int) -> int:
+        try:
+            from saturn_tpu.analysis.memlens import passes as ml_passes
+            sizes = sorted(
+                (g for g in task.feasible_strategies()
+                 if g <= topology.capacity), reverse=True)
+            for g in sizes:
+                fit = ml_passes.migration_fits(task, topology, g, cap)
+                if fit is not None:
+                    return int(fit["peak_bytes"])
+        except Exception:
+            pass
+        return self.resident_bytes(task)
+
+    # ---------------------------------------------------------- defrag wave
+    def plan_wave(self, blocked_tasks: Sequence, live_tasks: Sequence,
+                  topology, previous_plan) -> DefragWave:
+        return plan_defrag_wave(
+            blocked_tasks, live_tasks, topology, previous_plan,
+            self.resident_bytes, cap_bytes=self._capacity_bytes(topology),
+        )
+
+    def execute_wave(
+        self,
+        wave: DefragWave,
+        tasks_by_name: Dict[str, Any],
+        interval_index: int,
+        publish_fn: Optional[Callable[[Any], bool]] = None,
+        release_fn: Optional[Callable[[Any], None]] = None,
+    ) -> Optional[str]:
+        """Execute a planned wave move by move with two-phase journaling.
+
+        Per move: durable ``migration_intent`` → ``defrag.pre-publish``
+        barrier → ``publish_fn(task)`` verifies (or forces) the victim's
+        checkpoint durable, journaling ``ckpt_published`` — a False return
+        rolls the move back without touching state → release the victim's
+        device-resident live state → ``defrag.pre-commit`` barrier →
+        ``migration_done`` group-committed → ``defrag.post-commit``
+        barrier. Recovery closes any intent that lacks a done/rollback
+        (see ``durability/recovery.py``): resume iff a ``ckpt_published``
+        landed after the intent, else roll back — each exactly once.
+
+        Returns the wave id (None when the wave was empty).
+        """
+        if wave.empty:
+            return None
+        # sanctioned-unlocked: coordinator is single-threaded by design —
+        # only the owning control loop executes waves (see class docstring)
+        self._wave_seq += 1
+        wave_id = f"wave-{interval_index}-{self._wave_seq}"
+        jnl = self.journal
+        moved: List[str] = []
+        rolled_back: List[str] = []
+        for move in wave.moves:
+            task = tasks_by_name.get(move.task)
+            if task is None:
+                continue
+            if jnl is not None:
+                jnl.log(
+                    "migration_intent", wave=wave_id,
+                    interval=interval_index, **move.to_fields(),
+                )
+                jnl.barrier("defrag.pre-publish", wave=wave_id,
+                            task=move.task)
+            ok = True
+            if publish_fn is not None:
+                try:
+                    ok = bool(publish_fn(task))
+                except Exception as e:
+                    log.warning("defrag: publish failed for %s: %r",
+                                move.task, e)
+                    ok = False
+            if not ok:
+                rolled_back.append(move.task)
+                if jnl is not None:
+                    jnl.log(
+                        "migration_rollback", wave=wave_id, task=move.task,
+                        cause="publish-failed",
+                    )
+                continue
+            if release_fn is not None:
+                release_fn(task)
+            else:
+                release = getattr(task, "release_live_state", None)
+                if callable(release):
+                    release()
+            if jnl is not None:
+                jnl.barrier("defrag.pre-commit", wave=wave_id,
+                            task=move.task)
+                jnl.append(
+                    "migration_done", wave=wave_id, task=move.task,
+                    interval=interval_index,
+                )
+                jnl.commit()
+                jnl.barrier("defrag.post-commit", wave=wave_id,
+                            task=move.task)
+            moved.append(move.task)
+        if jnl is not None:
+            jnl.log(
+                "defrag_wave", wave=wave_id, interval=interval_index,
+                moves=moved, rolled_back=rolled_back,
+                admitted={k: list(v) for k, v in sorted(
+                    wave.admitted.items())},
+                still_blocked=sorted(wave.still_blocked),
+            )
+        metrics.event(
+            "defrag_wave", wave=wave_id, interval=interval_index,
+            moves=moved, rolled_back=rolled_back,
+            admitted=sorted(wave.admitted),
+            still_blocked=sorted(wave.still_blocked),
+        )
+        log.info(
+            "defrag: wave %s moved %d task(s), unblocked %d gang(s)%s",
+            wave_id, len(moved), len(wave.admitted),
+            f", {len(wave.still_blocked)} still blocked"
+            if wave.still_blocked else "",
+        )
+        return wave_id

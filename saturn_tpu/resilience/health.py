@@ -15,10 +15,16 @@ running; an operator policy could evict instead).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
+
+#: Env knob: how many consecutive healthy polls a returned device must
+#: survive before ``poll()`` surfaces a ``grow`` event (min 1 = immediate).
+ENV_GROW_HYSTERESIS = "SATURN_TPU_GROW_HYSTERESIS"
+DEFAULT_GROW_HYSTERESIS = 2
 
 
 @dataclass(frozen=True)
@@ -68,11 +74,17 @@ class FleetHealthMonitor:
 
     EWMA_ALPHA = 0.5  # latency observations are whole-interval averages
 
-    def __init__(self, n_devices: int, straggler_factor: float = 3.0):
+    def __init__(self, n_devices: int, straggler_factor: float = 3.0,
+                 grow_hysteresis: Optional[int] = None):
         if n_devices < 1:
             raise ValueError("n_devices must be positive")
         self.n_devices = n_devices
         self.straggler_factor = straggler_factor
+        if grow_hysteresis is None:
+            grow_hysteresis = int(
+                os.environ.get(ENV_GROW_HYSTERESIS, DEFAULT_GROW_HYSTERESIS)
+            )
+        self.grow_hysteresis = max(1, grow_hysteresis)
         self._devices: Dict[int, DeviceHealth] = {
             i: DeviceHealth() for i in range(n_devices)
         }
@@ -81,6 +93,11 @@ class FleetHealthMonitor:
         self._pending_lost: set = set()
         self._pending_gained: set = set()
         self._pending_cause: str = ""
+        # Returned devices serving out hysteresis: index -> consecutive
+        # healthy polls observed so far. They are alive (schedulable once a
+        # replan runs) but a grow event is withheld until the streak matures,
+        # so a blinking device cannot trigger replan churn.
+        self._grow_pending: Dict[int, int] = {}
         # id(device object) -> base index, set by for_topology/bind_devices.
         # Monitor indices always refer to the BASE (pre-fault) topology, so
         # fault schedules and metrics name stable device ids across shrinks;
@@ -129,13 +146,23 @@ class FleetHealthMonitor:
 
     def mark_lost(self, device_indices: Sequence[int], cause: str = "device_loss") -> None:
         with self._lock:
+            surfaced_any = False
             for i in device_indices:
                 d = self._devices.get(i)
-                if d is not None and d.alive:
-                    d.alive = False
-                    self._pending_lost.add(i)
-                    self._pending_gained.discard(i)
-            if cause:
+                if d is None or not d.alive:
+                    continue
+                d.alive = False
+                if i in self._grow_pending:
+                    # Flapped back down before the return was ever surfaced:
+                    # from the consumer's view the device has been dead the
+                    # whole time, so no new shrink event — just drop the
+                    # hysteresis candidate. One shrink total per flap storm.
+                    del self._grow_pending[i]
+                    continue
+                surfaced_any = True
+                self._pending_lost.add(i)
+                self._pending_gained.discard(i)
+            if cause and surfaced_any:
                 self._pending_cause = cause
 
     def mark_restored(self, device_indices: Sequence[int]) -> None:
@@ -146,8 +173,16 @@ class FleetHealthMonitor:
                     d.alive = True
                     d.latency_ewma = None  # returned chip: history is stale
                     d.slowdown = 1.0
-                    self._pending_gained.add(i)
+                    # An unsurfaced loss (in-window blink) is cancelled —
+                    # no shrink fires for a device that is already back. The
+                    # loss may still have been consumer-visible (a mid-
+                    # interval preemption kills running work before the
+                    # return lands), so the return is NOT a non-event: like
+                    # any return it must survive ``grow_hysteresis``
+                    # consecutive healthy polls, then surfaces as a grow
+                    # whose re-solve re-admits the requeued work.
                     self._pending_lost.discard(i)
+                    self._grow_pending[i] = 0
 
     def mark_straggler(self, device_indices: Sequence[int], slowdown: float) -> None:
         """Injected slowdown (fault schedule); detection stays latency-based."""
@@ -216,20 +251,38 @@ class FleetHealthMonitor:
         replan regardless of latency noise. A poll window containing both
         losses and returns reports ``shrink`` with both sets filled — the
         replanner rebuilds from the full alive set either way.
+
+        Grow is hysteresis-gated: a returned device must survive
+        ``grow_hysteresis`` consecutive healthy polls before a ``grow``
+        surfaces, so a blinking device cannot trigger replan churn. A shrink
+        in the meantime flushes candidates into its ``gained`` set (the
+        shrink replan rebuilds from the full alive set anyway).
         """
         with self._lock:
             lost = tuple(sorted(self._pending_lost))
-            gained = tuple(sorted(self._pending_gained))
             cause = self._pending_cause
             self._pending_lost.clear()
-            self._pending_gained.clear()
             self._pending_cause = ""
-        if lost:
-            return TopologyChange(
-                kind="shrink", lost=lost, gained=gained, cause=cause or "device_loss"
-            )
+            if lost:
+                gained = set(self._pending_gained) | set(self._grow_pending)
+                self._pending_gained.clear()
+                self._grow_pending.clear()
+                return TopologyChange(
+                    kind="shrink", lost=lost, gained=tuple(sorted(gained)),
+                    cause=cause or "device_loss",
+                )
+            matured = []
+            for i in sorted(self._grow_pending):
+                self._grow_pending[i] += 1
+                if self._grow_pending[i] >= self.grow_hysteresis:
+                    matured.append(i)
+                    del self._grow_pending[i]
+            gained = set(self._pending_gained) | set(matured)
+            self._pending_gained.clear()
         if gained:
-            return TopologyChange(kind="grow", gained=gained, cause=cause or "device_return")
+            return TopologyChange(
+                kind="grow", gained=tuple(sorted(gained)), cause="device_return"
+            )
         stragglers = self.stragglers()
         if stragglers:
             return TopologyChange(

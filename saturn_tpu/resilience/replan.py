@@ -40,7 +40,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from saturn_tpu.core.mesh import SliceTopology
+from saturn_tpu.core.mesh import Block, SliceTopology
 from saturn_tpu.core.strategy import Strategy
 from saturn_tpu.solver import anytime, milp
 from saturn_tpu.utils import metrics
@@ -380,3 +380,226 @@ class ElasticReplanner:
         plan = milp.Plan(assignments=assignments, makespan=makespan)
         plan.compute_dependencies()
         return plan
+
+
+# ----------------------------------------------------------------- defrag
+@dataclass(frozen=True)
+class DefragMove:
+    """One planned victim relocation: release the task's device-resident
+    live state (its checkpoint is current at every interval boundary) and
+    point its next restore at ``to_block`` instead of ``from_block``."""
+
+    task: str
+    from_block: Tuple[int, int]  # (offset, size)
+    to_block: Tuple[int, int]
+    pinned_bytes: int            # per-device HBM the move frees on the source
+    memlens: Optional[dict] = None
+
+    def to_fields(self) -> dict:
+        d = {
+            "task": self.task,
+            "from": list(self.from_block),
+            "to": list(self.to_block),
+            "pinned_bytes": self.pinned_bytes,
+        }
+        if self.memlens is not None:
+            d["memlens"] = self.memlens
+        return d
+
+
+@dataclass
+class DefragWave:
+    """A planned compaction wave: moves to execute, gangs that fit after."""
+
+    moves: List[DefragMove] = field(default_factory=list)
+    admitted: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    still_blocked: List[str] = field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not self.moves and not self.admitted
+
+
+def plan_defrag_wave(
+    blocked_tasks: Sequence,
+    live_tasks: Sequence,
+    topology: SliceTopology,
+    previous_plan: Optional[milp.Plan],
+    resident_bytes: Callable,
+    cap_bytes: Optional[int] = None,
+) -> DefragWave:
+    """Plan a defragmentation wave: compact running jobs onto other blocks
+    so a deferred gang's HBM footprint fits somewhere.
+
+    Between intervals a task's train state stays device-resident
+    (``task._live_state``) to skip the disk round-trip; that pinned HBM is
+    what blocks a large deferred gang even when the *schedule* has room.
+    This planner is occupancy-driven: per destination block it selects the
+    pinned live tasks overlapping it as victims, finds each victim a
+    relocation block with headroom (same size first, then halved feasible
+    sizes — "fewer slices"), and admits the gang when every victim
+    relocates and the gang's predicted peak fits the freed block.
+
+    ``resident_bytes(task) -> int`` reports the per-device bytes a task's
+    live state pins (0/unknown = not counted: the gate fails open, matching
+    memlens convention). Deterministic: all candidate orders are sorted.
+    The caller executes the moves (two-phase journal) and re-drains.
+    """
+    if cap_bytes is None:
+        try:
+            from saturn_tpu.analysis.memlens import passes as ml_passes
+            cap_bytes = ml_passes.hbm_capacity_bytes(topology.devices)
+        except Exception:
+            cap_bytes = 0
+    wave = DefragWave()
+    if cap_bytes <= 0:
+        # No capacity model: occupancy never blocked anyone — nothing to do.
+        wave.still_blocked = sorted(t.name for t in blocked_tasks)
+        return wave
+
+    try:
+        from saturn_tpu.analysis.memlens import passes as ml_passes
+    except Exception:
+        ml_passes = None
+
+    def _pinned(t) -> int:
+        try:
+            return max(0, int(resident_bytes(t) or 0))
+        except Exception:
+            return 0
+
+    # Current placements of pinned live tasks: name -> (Block, task, bytes).
+    placements: Dict[str, Tuple[Block, object, int]] = {}
+    for t in live_tasks:
+        a = previous_plan.assignments.get(t.name) if previous_plan else None
+        b = _pinned(t)
+        if a is not None and b > 0:
+            placements[t.name] = (a.block, t, b)
+
+    # Per-device pinned occupancy (device index -> bytes).
+    occ: Dict[int, int] = {}
+    for blk, _t, b in placements.values():
+        for i in range(blk.offset, blk.end):
+            occ[i] = occ.get(i, 0) + b
+
+    def _headroom(blk: Block, extra_occupied: Dict[int, int]) -> int:
+        used = max(
+            occ.get(i, 0) + extra_occupied.get(i, 0)
+            for i in range(blk.offset, blk.end)
+        )
+        return cap_bytes - used
+
+    moved: Dict[str, Tuple[Block, Block, int, Optional[dict]]] = {}
+    reserved: Dict[int, int] = {}  # this wave's placements (gangs + victims)
+
+    def _per_device_need(task, size: int) -> int:
+        if ml_passes is not None:
+            try:
+                fit = ml_passes.migration_fits(task, topology, size, cap_bytes)
+            except Exception:
+                fit = None
+            if fit is not None:
+                return int(fit["peak_bytes"])
+        return _pinned(task)
+
+    def _relocate(victim_name: str, forbidden: List[Block],
+                  extra: Dict[int, int]) -> Optional[Tuple[Block, int]]:
+        """Find a block the victim's live state can re-pin after restore."""
+        blk, vt, vb = placements[victim_name]
+        sizes: List[int] = []
+        g = blk.size
+        feas = set(vt.feasible_strategies())
+        while g >= 1:
+            if g in feas:
+                sizes.append(g)
+            g >>= 1
+        for size in sizes:
+            for cand in topology.blocks(size):
+                if cand.overlaps(blk):
+                    continue
+                if any(cand.overlaps(f) for f in forbidden):
+                    continue
+                # Victim's own pinned bytes vacate its old block, which we
+                # account for by excluding it below when checking overlap
+                # with itself (cand never overlaps blk).
+                if _headroom(cand, extra) >= vb:
+                    return cand, size
+        return None
+
+    for bt in sorted(blocked_tasks, key=lambda t: t.name):
+        feas = sorted(
+            (g for g in bt.feasible_strategies() if g <= topology.capacity),
+            reverse=True,
+        )
+        placed = False
+        for size in feas:
+            need = _per_device_need(bt, size)
+            for dest in topology.blocks(size):
+                victims = sorted(
+                    n for n, (blk, _t, _b) in placements.items()
+                    if n not in moved and blk.overlaps(dest)
+                )
+                # Occupancy on the destination if every victim vacates.
+                extra = dict(reserved)
+                trial_occ_delta: Dict[int, int] = {}
+                for n in victims:
+                    blk, _t, b = placements[n]
+                    for i in range(blk.offset, blk.end):
+                        trial_occ_delta[i] = trial_occ_delta.get(i, 0) - b
+                merged = dict(extra)
+                for i, d in trial_occ_delta.items():
+                    merged[i] = merged.get(i, 0) + d
+                if _headroom(dest, merged) < need:
+                    continue
+                # Find every victim a home outside the destination.
+                relocs: List[Tuple[str, Block, Block, int]] = []
+                trial_extra = dict(merged)
+                ok = True
+                for n in victims:
+                    r = _relocate(n, [dest], trial_extra)
+                    if r is None:
+                        ok = False
+                        break
+                    cand, _sz = r
+                    blk, _t, vb = placements[n]
+                    for i in range(cand.offset, cand.end):
+                        trial_extra[i] = trial_extra.get(i, 0) + vb
+                    relocs.append((n, blk, cand, vb))
+                if not ok:
+                    continue
+                # Commit the wave step.
+                for n, blk, cand, vb in relocs:
+                    _vblk, vt, _vb = placements[n]
+                    fit = None
+                    if ml_passes is not None:
+                        try:
+                            fit = ml_passes.migration_fits(
+                                vt, topology, cand.size, cap_bytes)
+                        except Exception:
+                            fit = None
+                    moved[n] = (blk, cand, vb, fit)
+                    for i in range(blk.offset, blk.end):
+                        occ[i] = occ.get(i, 0) - vb
+                    for i in range(cand.offset, cand.end):
+                        reserved[i] = reserved.get(i, 0) + vb
+                for i in range(dest.offset, dest.end):
+                    reserved[i] = reserved.get(i, 0) + need
+                wave.admitted[bt.name] = (dest.offset, dest.size)
+                placed = True
+                break
+            if placed:
+                break
+        if not placed:
+            wave.still_blocked.append(bt.name)
+
+    wave.moves = [
+        DefragMove(
+            task=n,
+            from_block=(f.offset, f.size),
+            to_block=(t.offset, t.size),
+            pinned_bytes=b,
+            memlens=fit,
+        )
+        for n, (f, t, b, fit) in sorted(moved.items())
+    ]
+    return wave

@@ -33,9 +33,10 @@ overriding priority classes or deadlines.
 from __future__ import annotations
 
 import logging
+import time
 import timeit
 from dataclasses import dataclass
-from typing import Any, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from saturn_tpu.core.mesh import SliceTopology
 from saturn_tpu.service.queue import JobRecord, JobState, SubmissionQueue
@@ -46,6 +47,13 @@ logger = logging.getLogger("saturn_tpu")
 ADMIT = "admit"
 REJECT = "reject"
 DEFER = "defer"
+
+# revisit_on hints carried by DEFER decisions: what event can change the
+# verdict, so operators (and the grow coordinator) know what a grow or
+# defrag wave would drain.
+REVISIT_INTERVAL = "interval"  # the tenant's own completions free the slot
+REVISIT_GROW = "grow"          # a grow event restores the missing capacity
+REVISIT_DEFRAG = "defrag"      # capacity exists; pinned HBM must compact
 
 
 @dataclass
@@ -60,6 +68,8 @@ class AdmissionDecision:
     # analysis (``analysis/shardflow/prior.py``), not from trials. Realized
     # feedback supersedes them; SAT-X005 audits the estimate afterwards.
     static_prior: bool = False
+    # DEFER only: which event should re-open this verdict (see REVISIT_*).
+    revisit_on: str = ""
 
 
 def _min_feasible_runtime(task) -> float:
@@ -111,6 +121,20 @@ class AdmissionController:
         #: Optional TenantLedger (set by ``SaturnService`` when tenancy is
         #: on): quota gates + fair-share weight scaling, see module doc.
         self.tenancy = None
+        #: Optional occupancy gate (set by the grow coordinator): called
+        #: ``gate(task, topology) -> verdict-dict | None`` after the size
+        #: fit passes. A ``{"fits": False, ...}`` verdict DEFERs with
+        #: ``revisit_on="defrag"`` — the schedule has room but other tasks'
+        #: device-resident live state pins too much HBM; a defrag wave can
+        #: free it. ``None`` = no verdict (fail open).
+        self.occupancy_gate: Optional[Callable] = None
+        #: DEFER pool: job_id -> {task, tenant, reason, revisit_on,
+        #: deferred_at, count}. Entries land on every DEFER and leave on
+        #: the job's next ADMIT/REJECT; the grow coordinator reads it to
+        #: know what a grow event or defrag wave would drain, and the
+        #: ``analysis grow``/``tenancy`` views report backlog age from the
+        #: journaled ``job_deferred`` records.
+        self.deferred: Dict[str, dict] = {}
         #: tenant -> jobs ADMITted in the *current* drain pass. The queue
         #: only counts a job as admitted once the post-solve SCHEDULED mark
         #: lands, so without this a burst draining in one pass would sail
@@ -161,6 +185,7 @@ class AdmissionController:
                     f"({mem['checked']} grid points, zero trials)"
                 ),
                 latency_s=timeit.default_timer() - t0,
+                revisit_on=REVISIT_GROW if degraded else "",
             )
             self._note(rec, dec)
             return dec
@@ -234,9 +259,37 @@ class AdmissionController:
                 trials_run=trials,
                 latency_s=timeit.default_timer() - t0,
                 static_prior=used_prior,
+                revisit_on=REVISIT_GROW if degraded else "",
             )
             self._note(rec, dec)
             return dec
+
+        # Occupancy gate (grow coordinator): the gang fits the schedule,
+        # but does its HBM footprint fit around other tasks' pinned live
+        # state? A negative verdict is DEFER, never REJECT — a defrag wave
+        # (or a completion releasing its state) re-opens it.
+        if self.occupancy_gate is not None:
+            try:
+                occ = self.occupancy_gate(task, topology)
+            except Exception as e:
+                logger.debug("admission: occupancy gate skipped: %r", e)
+                occ = None
+            if occ is not None and not occ.get("fits", True):
+                dec = AdmissionDecision(
+                    DEFER,
+                    reason=(
+                        "occupancy: pinned live state leaves "
+                        f"{occ.get('free_bytes', 0)} B free on every "
+                        f"fitting block, need {occ.get('need_bytes', 0)} B "
+                        "— a defrag wave can compact it"
+                    ),
+                    trials_run=trials,
+                    latency_s=timeit.default_timer() - t0,
+                    static_prior=used_prior,
+                    revisit_on=REVISIT_DEFRAG,
+                )
+                self._note(rec, dec)
+                return dec
 
         slack = None
         if rec.deadline_at is not None:
@@ -312,6 +365,7 @@ class AdmissionController:
                         f"at its max_live_jobs quota {quota.max_live_jobs}"
                     ),
                     latency_s=timeit.default_timer() - t0,
+                    revisit_on=REVISIT_INTERVAL,
                 )
         return None
 
@@ -377,12 +431,15 @@ class AdmissionController:
 
     def _note(self, rec: JobRecord, dec: AdmissionDecision) -> None:
         if self.journal is not None:
+            # sanctioned-unlocked: journal buffering is internally locked;
+            # admission runs only on the scheduler thread (see begin_pass)
             self.journal.append(
                 "job_admission", job=rec.job_id, task=rec.name,
                 decision=dec.action, reason=dec.reason,
                 trials_run=dec.trials_run, weight=round(dec.weight, 6),
                 static_prior=dec.static_prior, tenant=rec.tenant,
             )
+        self._note_deferred(rec, dec)
         metrics.event(
             "job_admitted", job=rec.job_id, task=rec.name,
             decision=dec.action, reason=dec.reason,
@@ -395,3 +452,32 @@ class AdmissionController:
             rec.job_id, dec.action, dec.reason or "ok", dec.trials_run,
             dec.weight, dec.latency_s,
         )
+
+    def _note_deferred(self, rec: JobRecord, dec: AdmissionDecision) -> None:
+        """Maintain the DEFER pool + journal ``job_deferred`` visibility
+        records. A record lands only on the *first* defer of a job or when
+        its reason class (revisit_on) changes — re-defers on the same
+        grounds would otherwise flood the journal every interval."""
+        if dec.action != DEFER:
+            self.deferred.pop(rec.job_id, None)
+            return
+        prev = self.deferred.get(rec.job_id)
+        entry = {
+            "task": rec.name,
+            "tenant": rec.tenant,
+            "reason": dec.reason,
+            "revisit_on": dec.revisit_on,
+            "deferred_at": prev["deferred_at"] if prev else time.time(),
+            "count": (prev["count"] + 1) if prev else 1,
+        }
+        self.deferred[rec.job_id] = entry
+        changed = prev is None or prev["revisit_on"] != dec.revisit_on
+        if changed and self.journal is not None:
+            # sanctioned-unlocked: journal buffering is internally locked;
+            # admission runs only on the scheduler thread (see begin_pass)
+            self.journal.append(
+                "job_deferred", job=rec.job_id, task=rec.name,
+                tenant=rec.tenant, reason=dec.reason,
+                revisit_on=dec.revisit_on,
+                at=round(entry["deferred_at"], 6),
+            )

@@ -184,10 +184,27 @@ class SaturnService:
         #: never be re-issued after a failover + restart.
         self.recovered_lease_epoch = 0
         self.recovered_lease_owner: Optional[str] = None
+        # Grow coordinator (resilience/grow.py): grow-event journaling,
+        # guardian unbench, DEFER-backlog drain attribution and two-phase
+        # defrag waves. Built before recovery (which seeds its wave
+        # sequence and the checkpoint map below); the journal attaches
+        # right after recovery opens it.
+        from saturn_tpu.resilience.grow import GrowCoordinator
+
+        self.grow = GrowCoordinator(journal=None)
+        #: task name -> last published checkpoint path (fed by the publish
+        #: hook in ``_run``, re-seeded from the journal on recovery); the
+        #: defrag wave's publish phase re-journals the victim's current
+        #: publication durably after its intent.
+        self._last_ckpt: Dict[str, str] = {}
         if durability_dir is not None:
             self._recover_from(durability_dir, crash_barrier)
         elif crash_barrier is not None:
             raise ValueError("crash_barrier requires durability_dir")
+        self.grow.journal = self.journal
+        #: current committed plan, mirrored from the loop local so the
+        #: admission occupancy gate can read it.
+        self._plan: Optional[milp.Plan] = None
 
         # Training-health guardian (sentinel policy + hung-dispatch
         # watchdog). On by default; pass ``health_guardian=False`` to
@@ -231,7 +248,43 @@ class SaturnService:
             # journal totals replace (never add to) the fresh counters.
             self.tenancy.restore(state.tenant_charges)
         if state.checkpoints:
-            rmod.reconcile_checkpoints(state.checkpoints)
+            # The newest checkpoint that survives verification becomes the
+            # task's authoritative publication again: a post-restart defrag
+            # wave verifies the victim's checkpoint through this map, so
+            # leaving it empty would roll back every wave until the next
+            # fresh publication.
+            for name, path in rmod.reconcile_checkpoints(
+                    state.checkpoints).items():
+                if path is not None:
+                    self._last_ckpt[name] = path
+        # Wave ids embed (interval, seq) and the interval counter restarts
+        # from zero: seed the sequence past the journal's highest so a
+        # post-restart wave can never reuse a closed (wave, task) key.
+        self.grow.seed_wave_seq(state.defrag_waves)
+        # Close every defrag move the crash left half-done — exactly once:
+        # resume (done) iff the victim's checkpoint was durably published
+        # AFTER the intent, else roll back. Closed intents never re-enter
+        # pending_migrations on later replays, so a second restart is a
+        # no-op here.
+        resume, rollback = state.resolve_pending_migrations()
+        for rec in resume:
+            self.journal.log(
+                "migration_done", wave=rec.get("wave", ""),
+                task=rec.get("task", ""), recovered=True,
+            )
+            logger.info(
+                "recovery: defrag move %s/%s resumed (checkpoint published "
+                "after intent)", rec.get("wave"), rec.get("task"),
+            )
+        for rec in rollback:
+            self.journal.log(
+                "migration_rollback", wave=rec.get("wave", ""),
+                task=rec.get("task", ""), cause="recovery", recovered=True,
+            )
+            logger.info(
+                "recovery: defrag move %s/%s rolled back (no published "
+                "checkpoint after intent)", rec.get("wave"), rec.get("task"),
+            )
         if state.jobs:
             restored = rmod.build_restore_records(state, self.task_provider)
             for rec in restored:
@@ -408,6 +461,7 @@ class SaturnService:
         if jnl is not None:
             def ckpt_hook(task_name, path):  # journal every publication
                 jnl.append("ckpt_published", task=task_name, path=path)
+                self._last_ckpt[task_name] = path
 
             ckpt_mod.add_publish_hook(ckpt_hook)
         try:
@@ -419,6 +473,14 @@ class SaturnService:
     def _run_loop(self, topo, tlimit, plan, jobs, interval_index) -> None:
         jnl = self.journal
         guardian = self.guardian
+        self._plan = plan
+        # Occupancy gate: an arrival whose HBM footprint can't fit around
+        # running tasks' pinned live state DEFERs (revisit_on="defrag")
+        # instead of admitting into an OOM; a defrag wave re-opens it.
+        self.admission.occupancy_gate = self.grow.occupancy_gate(
+            lambda: [r.task for r in jobs.values()],
+            lambda: self._plan,
+        )
 
         with metrics.scoped(self.metrics_path):
             self._ready.set()
@@ -443,6 +505,7 @@ class SaturnService:
 
                 # 1. health poll / topology change (elastic hook, as in the
                 #    batch loop)
+                grew = False
                 if self.health is not None:
                     if self.faults is not None:
                         self.faults.apply_due(interval_index, self.health)
@@ -455,6 +518,7 @@ class SaturnService:
                             self.replanner, change, plan, tlimit,
                             evicted_names,
                         )
+                        self._plan = plan
                         for name in evicted_names:
                             rec = jobs.pop(name, None)
                             if rec is not None:
@@ -469,36 +533,49 @@ class SaturnService:
                         if jnl is not None:
                             jnl.append("topology_change",
                                        **change.to_fields())
+                        if change.kind == "grow":
+                            # Recovery half of elasticity: journal the grow
+                            # event and short-circuit guardian benches so
+                            # parked work re-admits THIS interval (fault
+                            # streaks untouched).
+                            grew = True
+                            self.grow.note_grow(
+                                change, interval_index, guardian=guardian,
+                                n_deferred=len(self.admission.deferred),
+                                capacity=topo.capacity,
+                            )
                     elif change is not None:  # degrade: advisory only
                         metrics.event("topology_change", **change.to_fields())
 
-                # 2. drain arrivals through admission
-                newly_admitted: List[JobRecord] = []
-                self.admission.begin_pass()
-                for rec in self.queue.drain():
-                    if rec.cancel_requested:
-                        self.queue.mark(rec, JobState.EVICTED,
-                                        error="cancelled")
-                        metrics.event("job_evicted", job=rec.job_id,
-                                      task=rec.name, reason="cancelled")
-                        continue
-                    if guardian is not None and guardian.benched(
-                        rec.name, interval_index
-                    ):
-                        # Health backoff: still cooling down after a fault —
-                        # defer re-admission until its resume interval.
-                        self.queue.requeue(rec)
-                        continue
-                    dec = self.admission.admit(rec, topo)
-                    if dec.action == ADMIT:
-                        jobs[rec.name] = rec
-                        newly_admitted.append(rec)
-                        self._prewarm_admitted(rec, topo)
-                    elif dec.action == DEFER:
-                        self.queue.requeue(rec)
-                    else:  # REJECT
-                        self.queue.mark(rec, JobState.FAILED,
-                                        error=dec.reason)
+                # 2. drain arrivals through admission (deferred jobs re-enter
+                #    here every interval; a grow event or defrag wave below
+                #    is what actually changes their verdict)
+                deferred_before = set(self.admission.deferred)
+                newly_admitted = self._drain_arrivals(
+                    jobs, topo, interval_index, guardian
+                )
+
+                # 2b. defrag wave: deferred work blocked on pinned HBM
+                #     (revisit_on="defrag") gets an active compaction pass —
+                #     on every grow event and on the opportunistic poll.
+                if self.grow.defrag_due(interval_index, grew):
+                    wave_id = self._maybe_defrag_wave(
+                        jobs, topo, plan, interval_index
+                    )
+                    if wave_id is not None:
+                        # Re-drain so an unblocked gang admits this interval.
+                        newly_admitted.extend(self._drain_arrivals(
+                            jobs, topo, interval_index, guardian
+                        ))
+                drained = sorted(
+                    deferred_before
+                    & {r.job_id for r in newly_admitted}
+                )
+                if drained:
+                    self.grow.note_drained(
+                        drained, interval_index,
+                        trigger="grow" if grew else "interval",
+                    )
 
                 # 3. cancel sweep over admitted jobs
                 for rec in list(jobs.values()):
@@ -513,6 +590,7 @@ class SaturnService:
 
                 if not jobs:
                     plan = None
+                    self._plan = None
                     metrics.event("queue_depth", depth=self.queue.depth(),
                                   live=self.queue.live(), active=0)
                     interval_index += 1
@@ -568,6 +646,7 @@ class SaturnService:
                         raise  # no verified fallback: surface the failure
                 else:
                     plan = candidate
+                self._plan = plan
                 metrics.event(
                     "solve", makespan_s=plan.makespan, n_tasks=len(tasks),
                     solve_s=round(timeit.default_timer() - t_solve, 6),
@@ -748,6 +827,88 @@ class SaturnService:
                     len(self.queue.jobs()))
 
     # --------------------------------------------------------------- helpers
+    def _drain_arrivals(self, jobs: Dict[str, JobRecord], topo,
+                        interval_index: int, guardian) -> List[JobRecord]:
+        """One admission pass over the queue (service loop step 2). Also
+        called a second time after a defrag wave so a just-unblocked gang
+        admits in the same interval instead of the next."""
+        newly_admitted: List[JobRecord] = []
+        self.admission.begin_pass()
+        for rec in self.queue.drain():
+            if rec.cancel_requested:
+                self.queue.mark(rec, JobState.EVICTED, error="cancelled")
+                metrics.event("job_evicted", job=rec.job_id,
+                              task=rec.name, reason="cancelled")
+                continue
+            if guardian is not None and guardian.benched(
+                rec.name, interval_index
+            ):
+                # Health backoff: still cooling down after a fault —
+                # defer re-admission until its resume interval. A grow
+                # event short-circuits the bench (grow.note_grow), so
+                # parked work passes straight through here.
+                self.queue.requeue(rec)
+                continue
+            dec = self.admission.admit(rec, topo)
+            if dec.action == ADMIT:
+                jobs[rec.name] = rec
+                newly_admitted.append(rec)
+                self._prewarm_admitted(rec, topo)
+            elif dec.action == DEFER:
+                self.queue.requeue(rec)
+            else:  # REJECT
+                self.queue.mark(rec, JobState.FAILED, error=dec.reason)
+        return newly_admitted
+
+    def _maybe_defrag_wave(self, jobs: Dict[str, JobRecord], topo,
+                           plan, interval_index: int) -> Optional[str]:
+        """Plan + execute one defrag wave over the occupancy-blocked DEFER
+        backlog. Returns the wave id, or None when nothing was blocked or
+        no compaction helps. Every move is two-phase journaled (see
+        ``GrowCoordinator.execute_wave``)."""
+        import os as _os
+
+        from saturn_tpu.service.admission import REVISIT_DEFRAG
+
+        blocked_ids = sorted(
+            job_id for job_id, e in self.admission.deferred.items()
+            if e.get("revisit_on") == REVISIT_DEFRAG
+        )
+        if not blocked_ids or plan is None:
+            return None
+        blocked_tasks = []
+        for job_id in blocked_ids:
+            try:
+                blocked_tasks.append(self.queue.get(job_id).task)
+            except KeyError:
+                continue
+        if not blocked_tasks:
+            return None
+        live_tasks = [r.task for r in jobs.values()]
+        wave = self.grow.plan_wave(blocked_tasks, live_tasks, topo, plan)
+        if wave.empty:
+            return None
+
+        jnl = self.journal
+
+        def publish(task) -> bool:
+            # The victim's checkpoint is current at every interval boundary
+            # (finalization = checkpoint write + live-state republish);
+            # re-journal the publication durably AFTER the move's intent so
+            # a kill before migration_done resumes instead of rolling back.
+            path = self._last_ckpt.get(task.name)
+            if path is None or not _os.path.exists(path):
+                return False  # nothing durable to resume from: roll back
+            if jnl is not None:
+                jnl.log("ckpt_published", task=task.name, path=path,
+                        wave_republish=True)
+            return True
+
+        return self.grow.execute_wave(
+            wave, {t.name: t for t in live_tasks}, interval_index,
+            publish_fn=publish,
+        )
+
     def _weight(self, rec: JobRecord) -> float:
         slack = None
         if rec.deadline_at is not None:

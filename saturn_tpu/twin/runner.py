@@ -123,6 +123,7 @@ class _Counters:
     retries: int = 0
     crashes: int = 0
     topology_changes: int = 0
+    backlog_drained: int = 0
     pressure_sheds: int = 0
     solves: int = 0
     deadline_misses: int = 0
@@ -299,6 +300,14 @@ class TwinCampaign:
             self.topology, self.queue, technique_names=["twin-virtual"],
         )
         self.admission.journal = self.journal
+        # The REAL grow coordinator: grow events journal, the DEFER backlog
+        # drains with attribution. Virtual tasks carry no device-resident
+        # live state, so the occupancy gate fails open and defrag waves
+        # plan empty — the grow path itself runs for real and stays
+        # deterministic (every journaled/evented field is interval-indexed).
+        from saturn_tpu.resilience.grow import GrowCoordinator
+
+        self.grow = GrowCoordinator(journal=self.journal)
         self.health = FleetHealthMonitor.for_topology(self.topology)
         self.replanner = ElasticReplanner(
             policy=cfg.recovery_policy,
@@ -403,6 +412,7 @@ class TwinCampaign:
                 return "max-intervals"
 
             # 1. health poll / topology change
+            grew = False
             if self.faults is not None:
                 self.faults.apply_due(interval_index, self.health)
             change = self.health.poll()
@@ -426,12 +436,24 @@ class TwinCampaign:
                 self._event("topology_change", change=change.kind,
                             lost=list(change.lost),
                             gained=list(change.gained))
+                if change.kind == "grow":
+                    # Recovery half: journal the grow event (the twin has
+                    # no guardian benches to release).
+                    grew = True
+                    self.grow.note_grow(
+                        change, interval_index,
+                        n_deferred=len(self.admission.deferred),
+                        capacity=topo.capacity,
+                    )
+                    self._event("grow_event", gained=list(change.gained),
+                                n_deferred=len(self.admission.deferred))
             elif change is not None:  # degrade: advisory only
                 metrics.event("topology_change", **change.to_fields())
                 self._event("topology_change", change=change.kind,
                             stragglers=list(change.stragglers))
 
             # 2. drain arrivals through admission (the real controller)
+            deferred_before = set(self.admission.deferred)
             newly_admitted: List[JobRecord] = []
             for rec in self.queue.drain():
                 dec = self.admission.admit(rec, topo)
@@ -446,6 +468,15 @@ class TwinCampaign:
                 else:  # REJECT
                     self.queue.mark(rec, JobState.FAILED, error=dec.reason)
                     c.failed += 1
+            drained = sorted(
+                deferred_before & {r.job_id for r in newly_admitted}
+            )
+            if drained:
+                trigger = "grow" if grew else "interval"
+                self.grow.note_drained(drained, interval_index,
+                                       trigger=trigger)
+                c.backlog_drained += len(drained)
+                self._event("backlog_drain", jobs=drained, trigger=trigger)
 
             # 3. (no cancel sweep: the twin has no interactive clients)
 
@@ -645,6 +676,7 @@ class TwinCampaign:
             "retries": c.retries,
             "crashes": c.crashes,
             "topology_changes": c.topology_changes,
+            "backlog_drained": c.backlog_drained,
             "pressure_sheds": c.pressure_sheds,
             "intervals": getattr(self, "_intervals", 0),
             "makespan_s": round(self.clock.now(), 6),

@@ -165,13 +165,63 @@ class TestHealthMonitor:
         assert mon.poll() is None  # consumed
 
     def test_grow_after_return(self):
-        mon = FleetHealthMonitor(8)
+        mon = FleetHealthMonitor(8, grow_hysteresis=2)
+        mon.mark_lost([7])
+        mon.poll()
+        mon.mark_restored([7])
+        assert mon.poll() is None  # hysteresis: first healthy poll withheld
+        c = mon.poll()
+        assert c.kind == "grow" and c.gained == (7,)
+        assert mon.alive_indices() == list(range(8))
+
+    def test_grow_immediate_with_hysteresis_one(self):
+        mon = FleetHealthMonitor(8, grow_hysteresis=1)
         mon.mark_lost([7])
         mon.poll()
         mon.mark_restored([7])
         c = mon.poll()
         assert c.kind == "grow" and c.gained == (7,)
-        assert mon.alive_indices() == list(range(8))
+
+    def test_grow_hysteresis_env_default(self, monkeypatch):
+        monkeypatch.delenv("SATURN_TPU_GROW_HYSTERESIS", raising=False)
+        assert FleetHealthMonitor(4).grow_hysteresis == 2
+        monkeypatch.setenv("SATURN_TPU_GROW_HYSTERESIS", "3")
+        assert FleetHealthMonitor(4).grow_hysteresis == 3
+        monkeypatch.setenv("SATURN_TPU_GROW_HYSTERESIS", "0")
+        assert FleetHealthMonitor(4).grow_hysteresis == 1  # clamped
+
+    def test_flapping_device_one_shrink_no_churn(self):
+        # A device that blinks down/up across polls yields exactly one
+        # shrink and zero grow events until it stays healthy K polls.
+        mon = FleetHealthMonitor(8, grow_hysteresis=2)
+        mon.mark_lost([3], cause="slice_preemption")
+        events = [mon.poll()]
+        for _ in range(4):  # flap: return, then lose again before maturing
+            mon.mark_restored([3])
+            events.append(mon.poll())  # streak 1 of 2 — withheld
+            mon.mark_lost([3])
+            events.append(mon.poll())  # candidate dropped — no new shrink
+        surfaced = [e for e in events if e is not None]
+        assert len(surfaced) == 1 and surfaced[0].kind == "shrink"
+        assert surfaced[0].lost == (3,)
+        # Once it finally stays up, the grow surfaces after K polls.
+        mon.mark_restored([3])
+        assert mon.poll() is None
+        c = mon.poll()
+        assert c.kind == "grow" and c.gained == (3,)
+
+    def test_shrink_flushes_hysteresis_candidates(self):
+        # A shrink mid-hysteresis surfaces candidates in its gained set —
+        # the replan rebuilds from the full alive set either way.
+        mon = FleetHealthMonitor(8, grow_hysteresis=3)
+        mon.mark_lost([6])
+        mon.poll()
+        mon.mark_restored([6])
+        assert mon.poll() is None
+        mon.mark_lost([1])
+        c = mon.poll()
+        assert c.kind == "shrink" and c.lost == (1,) and c.gained == (6,)
+        assert mon.poll() is None  # candidate consumed by the shrink
 
     def test_straggler_detection_via_latency(self):
         mon = FleetHealthMonitor(8, straggler_factor=3.0)
