@@ -624,7 +624,10 @@ def _orchestrate_loop(
                             task_list.extend(parked)
                             parked = []
                             if journal is not None:
-                                journal.append(
+                                # log, not append: durable alongside the
+                                # grow_event so a crash cannot drop the
+                                # drain attribution record.
+                                journal.log(
                                     "backlog_drain",
                                     interval=interval_index,
                                     jobs=names_back, trigger="grow",

@@ -146,7 +146,11 @@ class GrowCoordinator:
         if not jobs:
             return
         if self.journal is not None:
-            self.journal.append(
+            # log (durable now), not append: the drained jobs are already
+            # ADMITted by the time this records, so a crash before the next
+            # group commit would silently drop the drain attribution the
+            # recovered backlog_drained counter and operator views rely on.
+            self.journal.log(
                 "backlog_drain", interval=interval_index,
                 jobs=sorted(jobs), trigger=trigger,
             )
@@ -196,33 +200,49 @@ class GrowCoordinator:
                     occ[i] = occ.get(i, 0) + b
             if not occ:
                 return None  # nothing pinned: occupancy cannot block
-            need = self._need_bytes(task, topology, cap)
-            if need <= 0:
-                return None
-            best_free = 0
+            # ``need`` is per-apportionment: a smaller gang shards state
+            # over fewer devices and needs MORE bytes per device, so the
+            # fit check must price each candidate size on its own — a
+            # single largest-gang estimate would under-admit straight into
+            # the OOM this gate exists to prevent.
+            best = None  # (free, need) of the closest-to-fitting attempt
             for g in sorted(
                     (g for g in task.feasible_strategies()
                      if g <= topology.capacity), reverse=True):
+                need = self._need_bytes(task, topology, cap, size=g)
+                if need <= 0:
+                    return None  # no estimate for this size: fail open
                 for blk in topology.blocks(g):
                     used = max(
                         occ.get(i, 0) for i in range(blk.offset, blk.end)
                     )
                     free = cap - used
-                    best_free = max(best_free, free)
                     if free >= need:
                         return {"fits": True, "free_bytes": free,
                                 "need_bytes": need}
-            return {"fits": False, "free_bytes": best_free,
-                    "need_bytes": need}
+                    if best is None or need - free < best[1] - best[0]:
+                        best = (free, need)
+            if best is None:
+                return None  # no candidate placements: nothing to verdict
+            return {"fits": False, "free_bytes": best[0],
+                    "need_bytes": best[1]}
 
         return gate
 
-    def _need_bytes(self, task, topology, cap: int) -> int:
+    def _need_bytes(self, task, topology, cap: int,
+                    size: Optional[int] = None) -> int:
+        """Per-device HBM bytes the task needs at gang size ``size`` (or
+        the largest feasible size when unspecified). memlens prices the
+        exact apportionment; the task's own resident-bytes hint is the
+        fail-open fallback."""
         try:
             from saturn_tpu.analysis.memlens import passes as ml_passes
-            sizes = sorted(
-                (g for g in task.feasible_strategies()
-                 if g <= topology.capacity), reverse=True)
+            if size is not None:
+                sizes = [size]
+            else:
+                sizes = sorted(
+                    (g for g in task.feasible_strategies()
+                     if g <= topology.capacity), reverse=True)
             for g in sizes:
                 fit = ml_passes.migration_fits(task, topology, g, cap)
                 if fit is not None:

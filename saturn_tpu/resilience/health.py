@@ -93,11 +93,16 @@ class FleetHealthMonitor:
         self._pending_lost: set = set()
         self._pending_gained: set = set()
         self._pending_cause: str = ""
-        # Returned devices serving out hysteresis: index -> consecutive
-        # healthy polls observed so far. They are alive (schedulable once a
-        # replan runs) but a grow event is withheld until the streak matures,
-        # so a blinking device cannot trigger replan churn.
-        self._grow_pending: Dict[int, int] = {}
+        # Returned devices serving out hysteresis: index -> [streak,
+        # loss_surfaced]. ``streak`` counts consecutive healthy polls so
+        # far; ``loss_surfaced`` records whether the loss that preceded the
+        # return was ever surfaced to the consumer (a poll() reported the
+        # shrink) — an in-window blink cancels the shrink before it
+        # surfaces, so the consumer still believes the device alive. They
+        # are alive (schedulable once a replan runs) but a grow event is
+        # withheld until the streak matures, so a blinking device cannot
+        # trigger replan churn.
+        self._grow_pending: Dict[int, List] = {}
         # id(device object) -> base index, set by for_topology/bind_devices.
         # Monitor indices always refer to the BASE (pre-fault) topology, so
         # fault schedules and metrics name stable device ids across shrinks;
@@ -152,12 +157,16 @@ class FleetHealthMonitor:
                 if d is None or not d.alive:
                     continue
                 d.alive = False
-                if i in self._grow_pending:
-                    # Flapped back down before the return was ever surfaced:
-                    # from the consumer's view the device has been dead the
-                    # whole time, so no new shrink event — just drop the
-                    # hysteresis candidate. One shrink total per flap storm.
-                    del self._grow_pending[i]
+                cand = self._grow_pending.pop(i, None)
+                if cand is not None and cand[1]:
+                    # Flapped back down before the return was ever surfaced,
+                    # and the original loss WAS surfaced: from the consumer's
+                    # view the device has been dead the whole time, so no new
+                    # shrink event — just drop the hysteresis candidate. One
+                    # shrink total per flap storm. (If the original loss was
+                    # an in-window blink the consumer never saw, swallowing
+                    # here would leave it scheduling on a dead device forever
+                    # — fall through and surface the shrink instead.)
                     continue
                 surfaced_any = True
                 self._pending_lost.add(i)
@@ -180,9 +189,13 @@ class FleetHealthMonitor:
                     # return lands), so the return is NOT a non-event: like
                     # any return it must survive ``grow_hysteresis``
                     # consecutive healthy polls, then surfaces as a grow
-                    # whose re-solve re-admits the requeued work.
+                    # whose re-solve re-admits the requeued work. Whether the
+                    # loss was surfaced is remembered on the candidate: a
+                    # re-loss is swallowed only when the consumer already
+                    # believes the device dead (see ``mark_lost``).
+                    loss_surfaced = i not in self._pending_lost
                     self._pending_lost.discard(i)
-                    self._grow_pending[i] = 0
+                    self._grow_pending[i] = [0, loss_surfaced]
 
     def mark_straggler(self, device_indices: Sequence[int], slowdown: float) -> None:
         """Injected slowdown (fault schedule); detection stays latency-based."""
@@ -273,8 +286,9 @@ class FleetHealthMonitor:
                 )
             matured = []
             for i in sorted(self._grow_pending):
-                self._grow_pending[i] += 1
-                if self._grow_pending[i] >= self.grow_hysteresis:
+                cand = self._grow_pending[i]
+                cand[0] += 1
+                if cand[0] >= self.grow_hysteresis:
                     matured.append(i)
                     del self._grow_pending[i]
             gained = set(self._pending_gained) | set(matured)

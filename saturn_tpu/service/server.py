@@ -43,7 +43,12 @@ from saturn_tpu.service.admission import (
     AdmissionController,
     compute_weight,
 )
-from saturn_tpu.service.queue import JobRecord, JobState, SubmissionQueue
+from saturn_tpu.service.queue import (
+    TERMINAL_STATES,
+    JobRecord,
+    JobState,
+    SubmissionQueue,
+)
 from saturn_tpu.solver import anytime, milp
 from saturn_tpu.utils import metrics
 
@@ -491,6 +496,7 @@ class SaturnService:
                         for rec in list(jobs.values()):
                             self._evict(jobs, rec, "service aborted")
                         for rec in self.queue.drain():
+                            self.admission.deferred.pop(rec.job_id, None)
                             self.queue.mark(rec, JobState.EVICTED,
                                             error="service aborted")
                             metrics.event("job_evicted", job=rec.job_id,
@@ -833,9 +839,25 @@ class SaturnService:
         called a second time after a defrag wave so a just-unblocked gang
         admits in the same interval instead of the next."""
         newly_admitted: List[JobRecord] = []
+        # Reconcile the DEFER pool against terminal exits first: admission
+        # pops an entry only on a later ADMIT/REJECT, so a deferred job
+        # that leaves the queue terminally without a verdict (e.g. the
+        # queue's immediate cancel-evict) would otherwise inflate
+        # n_deferred, the backlog views, and defrag blocked_ids forever.
+        for job_id in list(self.admission.deferred):
+            try:
+                if self.queue.get(job_id).state in TERMINAL_STATES:
+                    self.admission.deferred.pop(job_id, None)
+            except KeyError:
+                self.admission.deferred.pop(job_id, None)
         self.admission.begin_pass()
         for rec in self.queue.drain():
             if rec.cancel_requested:
+                # Leaving the queue terminally WITHOUT an admission verdict:
+                # drop its DEFER-pool entry here (admission only pops on a
+                # later ADMIT/REJECT, which will never come), or it inflates
+                # n_deferred / backlog views / defrag blocked_ids forever.
+                self.admission.deferred.pop(rec.job_id, None)
                 self.queue.mark(rec, JobState.EVICTED, error="cancelled")
                 metrics.event("job_evicted", job=rec.job_id,
                               task=rec.name, reason="cancelled")
@@ -979,6 +1001,9 @@ class SaturnService:
                reason: str) -> None:
         jobs.pop(rec.name, None)
         self._release(rec.task, compiled=True)
+        # Terminal exit: make sure no stale DEFER-pool entry survives the
+        # job (normally a no-op — ADMIT already popped it).
+        self.admission.deferred.pop(rec.job_id, None)
         self.queue.mark(rec, JobState.EVICTED, error=reason)
         metrics.event("job_evicted", job=rec.job_id, task=rec.name,
                       reason=reason)

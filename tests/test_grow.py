@@ -167,6 +167,34 @@ class TestBlinkHysteresis:
         assert c.gained == (4,)  # candidate flushed into the shrink
         assert mon.poll() is None
 
+    def test_unsurfaced_blink_then_reloss_surfaces_shrink(self):
+        # Blink inside one window (shrink cancelled — the consumer still
+        # believes the device alive), then the device dies again while
+        # serving out hysteresis. The re-loss must NOT be swallowed: the
+        # original loss was never surfaced, so swallowing would leave the
+        # plan scheduling on a dead device forever.
+        mon = FleetHealthMonitor(8, grow_hysteresis=2)
+        mon.mark_lost([4], cause="slice_preemption")   # in-window
+        mon.mark_restored([4])                         # blink: cancelled
+        mon.mark_lost([4], cause="device_loss")        # dead again
+        c = mon.poll()
+        assert c is not None and c.kind == "shrink" and c.lost == (4,)
+        assert mon.poll() is None
+        assert mon.alive_indices() == [0, 1, 2, 3, 5, 6, 7]
+
+    def test_surfaced_loss_reloss_mid_hysteresis_stays_swallowed(self):
+        # The flap-storm contract is unchanged when the original loss WAS
+        # surfaced: the consumer has seen the device dead the whole time,
+        # so a re-loss mid-hysteresis emits nothing new.
+        mon = FleetHealthMonitor(8, grow_hysteresis=2)
+        mon.mark_lost([4], cause="device_loss")
+        c = mon.poll()
+        assert c.kind == "shrink" and c.lost == (4,)   # surfaced
+        mon.mark_restored([4])
+        mon.mark_lost([4], cause="device_loss")        # mid-hysteresis
+        assert mon.poll() is None                      # one shrink total
+        assert mon.poll() is None
+
 
 # --------------------------------------------------------- defrag planner
 class TestDefragPlanner:
@@ -240,6 +268,35 @@ class TestGrowCoordinator:
         assert kinds.count("migration_intent") == 1
         assert kinds.count("migration_done") == 1
         assert "defrag_wave" in kinds
+
+    def test_occupancy_gate_prices_need_per_gang_size(self, monkeypatch):
+        # A smaller gang shards state over FEWER devices and needs MORE
+        # bytes per device. The gate must price each candidate size with
+        # its own memlens fit — a single largest-gang estimate would admit
+        # a 2-device placement using the 4-device (smaller) need and OOM.
+        from saturn_tpu.analysis.memlens import passes as ml_passes
+
+        per_size_need = {4: 50, 2: 110}
+        monkeypatch.setattr(
+            ml_passes, "migration_fits",
+            lambda task, topology, g, cap: {"peak_bytes": per_size_need[g]},
+        )
+        live = [PinnedTask("live-a", (2,), resident=PIN),
+                PinnedTask("live-b", (2,), resident=PIN)]
+        gang = PinnedTask("gang", (2, 4), resident=NEED)
+        # Pins land in both 4-blocks: free 40 < 50 at size 4. Every empty
+        # 2-block has free 100 >= the stale 50 but < the true 110.
+        plan = FakePlan({"live-a": _Slot(Block(0, 2)),
+                         "live-b": _Slot(Block(4, 2))})
+        coord = GrowCoordinator(poll_every=0)
+        verdict = coord.occupancy_gate(lambda: live, lambda: plan)(
+            gang, topo(8))
+        assert verdict is not None and verdict["fits"] is False
+        # And the per-size need still admits when a block truly fits it.
+        per_size_need[2] = 90  # 2-device apportionment now fits free=100
+        verdict = coord.occupancy_gate(lambda: live, lambda: plan)(
+            gang, topo(8))
+        assert verdict["fits"] is True and verdict["need_bytes"] == 90
 
     def test_occupancy_gate_fails_open(self, monkeypatch):
         live, gang, plan = _scenario()
@@ -387,6 +444,53 @@ class TestAdmissionRevisit:
         ctrl.occupancy_gate = boom
         rec = self._submit(q, PinnedTask("ok", (2,)))
         assert ctrl.admit(rec, t8).action == ADMIT
+
+
+class TestDeferPoolCancelReconcile:
+    """A deferred job that leaves the queue terminally WITHOUT a later
+    ADMIT/REJECT (cancel) must not leak its DEFER-pool entry — a leaked
+    entry inflates n_deferred, the backlog views, and defrag blocked_ids
+    forever."""
+
+    def _deferred_job(self, svc, name):
+        from saturn_tpu.service.admission import DEFER
+        from saturn_tpu.service.queue import JobRequest
+
+        rec = svc.queue.submit(JobRequest(
+            PinnedTask(name, (4,), resident=NEED)))
+        svc.admission.occupancy_gate = lambda task, topology: {
+            "fits": False, "free_bytes": 0, "need_bytes": NEED}
+        svc.admission.begin_pass()
+        dec = svc.admission.admit(rec, topo(8))
+        assert dec.action == DEFER
+        svc.queue.requeue(rec)
+        assert rec.job_id in svc.admission.deferred
+        return rec
+
+    def test_queue_side_cancel_evict_reconciles(self):
+        # queue.cancel evicts a QUEUED job immediately, bypassing the
+        # admission verdict that would normally pop the pool entry; the
+        # next drain pass reconciles against the terminal exit.
+        from saturn_tpu.service import SaturnService
+
+        svc = SaturnService(topology=topo(8), interval=0.2, poll_s=0.01)
+        rec = self._deferred_job(svc, "gang-cancel-q")
+        assert svc.queue.cancel(rec.job_id) is True
+        svc._drain_arrivals({}, topo(8), 0, None)
+        assert rec.job_id not in svc.admission.deferred
+
+    def test_cancel_requested_in_drain_pops_entry(self):
+        # A cancel that lands as a flag (race with the drain) is honored
+        # inside _drain_arrivals itself: EVICTED + pool entry popped.
+        from saturn_tpu.service import SaturnService
+        from saturn_tpu.service.queue import JobState
+
+        svc = SaturnService(topology=topo(8), interval=0.2, poll_s=0.01)
+        rec = self._deferred_job(svc, "gang-cancel-flag")
+        rec.cancel_requested = True  # flag only: still on the arrival queue
+        svc._drain_arrivals({}, topo(8), 0, None)
+        assert rec.state is JobState.EVICTED
+        assert rec.job_id not in svc.admission.deferred
 
 
 # ------------------------------------------------------------- kill-replay
