@@ -1023,6 +1023,113 @@ def grow_defrag_errors() -> list:
     return validate_grow_row(row)
 
 
+#: Required key -> type for the ``benchmarks/comm_overlap.py`` row.
+OVERLAP_ROW_REQUIRED = {
+    "metric": str,               # "comm_overlap"
+    "platform": str,
+    "host_cores": int,
+    "pairs": dict,               # per-lowering serial/overlapped results
+    "headline": str,
+    "serial_ms": float,
+    "overlapped_ms": float,
+    "speedup": float,
+    "mfu_serial": float,
+    "mfu_overlapped": float,
+    "bit_identical": bool,       # SGD loss trajectories bitwise equal
+    "priced": dict,              # shardflow static pricing, serial vs over
+}
+
+#: Measured-step-time noise tolerance. On a host that cannot overlap (one
+#: core: XLA runs every thunk serially) the double-buffered program pays a
+#: small copy tax over serial — bounded, not a regression. On hardware with
+#: real DMA/compute concurrency the bar tightens to "no slower than serial".
+OVERLAP_TOL_PCT = float(os.environ.get("SATURN_OVERLAP_TOL_PCT", "15"))
+
+
+def validate_overlap_row(row) -> list:
+    """Schema + acceptance check for one comm_overlap row.
+
+    Bars: every pair's loss trajectory bitwise equal across the knob flip
+    (overlap must never change arithmetic); measured overlapped step time
+    within ``OVERLAP_TOL_PCT`` of serial everywhere and <= serial outright
+    on hosts that can actually overlap (TPU, or multi-core CPU); MFU
+    non-decreasing within the same tolerance; and the shardflow-priced
+    speedup strictly > 1 — the deterministic witness that the per-op-class
+    overlap factors re-price the placement."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict ({type(row).__name__})"]
+    problems = []
+    for key, typ in OVERLAP_ROW_REQUIRED.items():
+        if key not in row:
+            problems.append(f"missing key {key!r}")
+            continue
+        val = row[key]
+        if typ in (int, float) and isinstance(val, bool):
+            problems.append(f"{key!r} is bool, expected {typ.__name__}")
+        elif typ is float and isinstance(val, int):
+            pass
+        elif not isinstance(val, typ):
+            problems.append(
+                f"{key!r} is {type(val).__name__}, expected {typ.__name__}"
+            )
+    if row.get("metric") != "comm_overlap":
+        problems.append(
+            f"metric is {row.get('metric')!r}, expected 'comm_overlap'"
+        )
+    if row.get("bit_identical") is not True:
+        problems.append(
+            "bit_identical is not true (an overlap knob changed the "
+            "arithmetic, not just the communication schedule)"
+        )
+    tol = OVERLAP_TOL_PCT / 100.0
+    sp = row.get("speedup")
+    if isinstance(sp, (int, float)) and not isinstance(sp, bool):
+        can_overlap = (
+            row.get("platform") == "tpu" or int(row.get("host_cores", 1)) > 1
+        )
+        if can_overlap and sp < 1.0:
+            problems.append(
+                f"headline speedup {sp} < 1.0 on a host that can overlap "
+                "(overlapped step time exceeds serial)"
+            )
+        elif sp < 1.0 - tol:
+            problems.append(
+                f"headline speedup {sp} < {1.0 - tol:.2f} (the overlapped "
+                "program costs more than the serialized-host copy tax)"
+            )
+    mfu_s, mfu_o = row.get("mfu_serial"), row.get("mfu_overlapped")
+    if all(isinstance(x, (int, float)) and not isinstance(x, bool)
+           for x in (mfu_s, mfu_o)) and mfu_o < mfu_s * (1.0 - tol):
+        problems.append(
+            f"mfu_overlapped {mfu_o} dropped more than {OVERLAP_TOL_PCT}% "
+            f"below mfu_serial {mfu_s}"
+        )
+    priced = row.get("priced")
+    if isinstance(priced, dict):
+        psp = priced.get("speedup")
+        if not (isinstance(psp, (int, float)) and not isinstance(psp, bool)
+                and psp > 1.0):
+            problems.append(
+                f"priced speedup {psp!r} not > 1.0 (the overlap factors "
+                "no longer discount the overlapped lowering's wire time)"
+            )
+    return problems
+
+
+def comm_overlap_errors() -> list:
+    """Run the comm/compute overlap bench and validate its row.
+
+    The heavyweight part of the guard (a few minutes of jit on a cold CPU
+    host): three serial/overlapped program pairs stepped for bit-identity
+    and timed, plus the shardflow-priced pair. Kept at low reps — the
+    validation bars are tolerance-based, not throughput-based."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import comm_overlap
+
+    row = comm_overlap.run(reps=3, steps=2)
+    return validate_overlap_row(row)
+
+
 def shape_key(parsed: dict) -> tuple:
     """What must match for two bench numbers to be comparable."""
     return (
@@ -1129,6 +1236,21 @@ def main() -> int:
         print(json.dumps({
             "metric": "bench_guard", "status": "grow_defrag_failed",
             "value": new.get("value"), "diagnostics": gd_errors,
+        }))
+        return 1
+    try:
+        ov_errors = comm_overlap_errors()
+    except Exception as e:
+        ov_errors = [f"comm overlap bench unavailable: "
+                     f"{type(e).__name__}: {e}"]
+    if ov_errors:
+        # Same refusal for the overlapped lowerings: a knob flip that
+        # changed arithmetic (or an overlapped program that got slower
+        # than its serial twin beyond the serialized-host tax) must not
+        # be recorded as a baseline.
+        print(json.dumps({
+            "metric": "bench_guard", "status": "comm_overlap_failed",
+            "value": new.get("value"), "diagnostics": ov_errors,
         }))
         return 1
     out = {

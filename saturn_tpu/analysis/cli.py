@@ -36,6 +36,9 @@ Subcommands:
   stream — per-group membership and lockstep throughput
   (``fused_interval``), unfuse events with the interval step each member
   left at (``fused_unfuse``), and fused-trial pricing (``trial_fused``).
+- ``mfu PATH``: operator view of achieved TFLOP/s and MFU — p50/p99 per
+  task and per technique, from ``task_interval`` events in a metrics
+  JSONL file or a directory of them.
 - ``shardflow``: saturn-shardflow's jaxpr-level sharding-propagation pass
   over every in-tree technique — traces each step function on virtual CPU
   devices (no chip), propagates PartitionSpecs through every equation, and
@@ -802,6 +805,79 @@ def _cmd_solver(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_mfu(args: argparse.Namespace) -> int:
+    import glob
+    import os
+
+    from saturn_tpu.utils import metrics
+
+    if os.path.isdir(args.path):
+        paths = sorted(glob.glob(
+            os.path.join(args.path, "**", "*.jsonl"), recursive=True
+        ))
+        if not paths:
+            print(f"no *.jsonl metrics files under {args.path!r}",
+                  file=sys.stderr)
+            return 2
+    else:
+        paths = [args.path]
+
+    events = []
+    for p in paths:
+        try:
+            events.extend(metrics.read_events(p, kind="task_interval"))
+        except OSError as e:
+            print(f"cannot read metrics at {p!r}: {e}", file=sys.stderr)
+            return 2
+
+    # tflops/mfu are additive fields: intervals recorded with metrics off
+    # mid-run, or whose step couldn't be shardflow-traced, simply lack them.
+    perf = [ev for ev in events
+            if isinstance(ev.get("tflops"), (int, float))
+            and isinstance(ev.get("mfu"), (int, float))]
+
+    def summarize(group_key):
+        groups: dict = {}
+        for ev in perf:
+            groups.setdefault(str(ev.get(group_key, "?")), []).append(ev)
+        out = {}
+        for name, evs in sorted(groups.items()):
+            tf = [float(e["tflops"]) for e in evs]
+            mf = [float(e["mfu"]) for e in evs]
+            out[name] = {
+                "intervals": len(evs),
+                "tflops_p50": round(_percentile(tf, 0.50), 4),
+                "tflops_p99": round(_percentile(tf, 0.99), 4),
+                "mfu_p50": round(_percentile(mf, 0.50), 6),
+                "mfu_p99": round(_percentile(mf, 0.99), 6),
+            }
+        return out
+
+    payload = {
+        "intervals": len(events),
+        "with_perf": len(perf),
+        "tasks": summarize("task"),
+        "techniques": summarize("technique"),
+    }
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+        return 0
+    if not events:
+        print(f"{args.path}: no task_interval events")
+        return 0
+    print(f"{args.path}: {len(events)} interval(s), "
+          f"{len(perf)} with achieved-perf fields")
+    for title, rows in (("task", payload["tasks"]),
+                        ("technique", payload["techniques"])):
+        for name, row in rows.items():
+            print(f"  {title} {name}: {row['intervals']} interval(s), "
+                  f"TFLOP/s p50 {row['tflops_p50']:.3f} "
+                  f"p99 {row['tflops_p99']:.3f}, "
+                  f"MFU p50 {100 * row['mfu_p50']:.2f}% "
+                  f"p99 {100 * row['mfu_p99']:.2f}%")
+    return 0
+
+
 def _cmd_fusion(args: argparse.Namespace) -> int:
     from saturn_tpu.utils import metrics
 
@@ -1192,6 +1268,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     f.add_argument("path")
     f.set_defaults(fn=_cmd_fusion)
+
+    u = sub.add_parser(
+        "mfu",
+        help="operator view of achieved TFLOP/s + MFU per task and per "
+             "technique from task_interval events (a metrics JSONL, or a "
+             "directory of them)",
+    )
+    u.add_argument("path")
+    u.set_defaults(fn=_cmd_mfu)
 
     x = sub.add_parser(
         "shardflow",

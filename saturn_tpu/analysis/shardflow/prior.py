@@ -46,6 +46,27 @@ _ENV_PEAK = "SATURN_TPU_PRIOR_PEAK_FLOPS"
 _ENV_ICI = "SATURN_TPU_PRIOR_ICI_BYTES_S"
 _ENV_DCN = "SATURN_TPU_PRIOR_DCN_BYTES_S"
 _ENV_MFU = "SATURN_TPU_PRIOR_MFU"
+_ENV_OVERLAP_PREFIX = "SATURN_TPU_PRIOR_OVERLAP_"
+
+#: Per-op-class fraction of wire time the overlapped lowering hides under
+#: compute (``{"overlap": True}`` grid points: double-buffered ppermute
+#: hops in ring/pipeline, collective-matmul / ZeRO-3 prefetch gathers).
+#: Static seeds, deliberately conservative; :func:`calibrate_overlap_factors`
+#: moves them from the SAT-X005 audit stream and
+#: ``SATURN_TPU_PRIOR_OVERLAP_<OP>`` pins them per deployment. Serial grid
+#: points keep the fully-pessimistic un-overlapped pricing.
+DEFAULT_OVERLAP_FACTORS: Dict[str, float] = {
+    "ppermute": 0.7,        # neighbor hop rides under the chunk's compute
+    "all_gather": 0.6,      # layer-ahead prefetch / chunked partial products
+    "reduce_scatter": 0.3,  # grad scatter partially hides behind backward
+    "all_reduce": 0.0,      # grad psum gates the optimizer: critical path
+    "all_to_all": 0.0,      # MoE dispatch has no overlapped lowering yet
+}
+
+# Calibrated deltas layered over the defaults (process-local; the factor
+# set is stamped into the profile-cache fingerprint, so recalibration
+# invalidates stale entries instead of silently repricing them).
+_calibrated_factors: Dict[str, float] = {}
 
 
 def _envf(name: str, default: float) -> float:
@@ -53,6 +74,24 @@ def _envf(name: str, default: float) -> float:
         return float(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def overlap_factors() -> Dict[str, float]:
+    """Active per-op-class overlap factor set: defaults, then calibration,
+    then env pins — each clamped to [0, 1]."""
+    out = dict(DEFAULT_OVERLAP_FACTORS)
+    out.update(_calibrated_factors)
+    for op in out:
+        out[op] = min(
+            max(_envf(_ENV_OVERLAP_PREFIX + op.upper(), out[op]), 0.0), 1.0
+        )
+    return out
+
+
+def overlap_factor_signature() -> str:
+    """Stable signature of the active factor set for cache fingerprints."""
+    f = overlap_factors()
+    return ",".join(f"{k}={f[k]:.4f}" for k in sorted(f))
 
 
 def hardware_model() -> Dict[str, float]:
@@ -69,17 +108,41 @@ def estimate_step_seconds(
     ledger: CommLedger, size: int,
     crossing: Optional[frozenset] = None,
     hw: Optional[Dict[str, float]] = None,
+    overlap: bool = False,
+    factors: Optional[Dict[str, float]] = None,
 ) -> float:
-    """Static per-batch seconds from one ledger: roofline compute +
-    un-overlapped communication, DCN-priced for axes in ``crossing``."""
+    """Static per-batch seconds from one ledger: roofline compute + wire
+    time, DCN-priced for axes in ``crossing``. Serial (default) prices every
+    collective un-overlapped; ``overlap=True`` discounts each op class by
+    the active :func:`overlap_factors` — the pricing for ``overlap`` grid
+    points, never for the serial lowering."""
     hw = hw or hardware_model()
     compute = ledger.flops / max(size, 1) / (hw["peak_flops"] * hw["mfu"])
+    f = (factors if factors is not None else overlap_factors()) if overlap \
+        else {}
     comm = 0.0
     cross = crossing or frozenset()
     for rec in ledger.records:
         bw = hw["dcn_bytes_s"] if set(rec.axes) & cross else hw["ici_bytes_s"]
-        comm += rec.wire_bytes * rec.count / bw
+        comm += (rec.wire_bytes * rec.count / bw) * (
+            1.0 - f.get(rec.op, 0.0)
+        )
     return max(compute + comm, 1e-9)
+
+
+def comm_seconds_by_op(
+    ledger: CommLedger, crossing: Optional[frozenset] = None,
+    hw: Optional[Dict[str, float]] = None,
+) -> Dict[str, float]:
+    """Un-overlapped wire seconds per op class — the breakdown the
+    calibrator needs to attribute a measured overlap win to op classes."""
+    hw = hw or hardware_model()
+    cross = crossing or frozenset()
+    out: Dict[str, float] = {}
+    for rec in ledger.records:
+        bw = hw["dcn_bytes_s"] if set(rec.axes) & cross else hw["ici_bytes_s"]
+        out[rec.op] = out.get(rec.op, 0.0) + rec.wire_bytes * rec.count / bw
+    return out
 
 
 def _resolve_techniques(technique_names: Optional[List[str]]) -> Dict[str, Any]:
@@ -162,7 +225,10 @@ def synthesize_strategies(
                     )
                     continue
                 cross = crossing_axes(traced["mesh_axes"], ss)
-                t = estimate_step_seconds(ledger, g, crossing=cross)
+                overlapped = bool(config.get("overlap", False))
+                t = estimate_step_seconds(
+                    ledger, g, crossing=cross, overlap=overlapped
+                )
                 if t < best_t:
                     best_t = t
                     # Analytic schedule bubble (pipeline GPipe/1F1B
@@ -187,6 +253,14 @@ def synthesize_strategies(
                             task_sig, name, g, topo_sig
                         ),
                         bubble_fraction=bubble,
+                    )
+                    best._static_overlap = overlapped
+                    best._static_compute_s = estimate_step_seconds(
+                        ledger, g, crossing=cross,
+                        factors={}, overlap=False,
+                    ) - sum(comm_seconds_by_op(ledger, crossing=cross).values())
+                    best._static_comm_by_op = comm_seconds_by_op(
+                        ledger, crossing=cross
                     )
         if best is not None:
             best._static_prior_estimate = best_t
@@ -242,3 +316,67 @@ def audit_task(task: Any,
         if d is not None:
             diags.append(d)
     return diags
+
+
+# ------------------------------------------------ overlap factor calibration
+def calibrate_overlap_factors(
+    tasks: Sequence[Any], blend: float = 0.25,
+) -> Dict[str, float]:
+    """Move :func:`overlap_factors` from static seeds toward measured truth.
+
+    Consumes the same stream SAT-X005 audits: strategies synthesized with an
+    ``overlap`` config whose static prior has since been superseded by a
+    realized measurement (``static_prior`` flipped off in place, so the
+    stashed ``_static_*`` decomposition survives). For each such point the
+    measured step time implies how much wire time the overlapped lowering
+    actually hid::
+
+        hidden = (compute_s + comm_total - measured) / comm_total
+
+    clamped to [0, 1]. One scalar cannot separate op classes, so the update
+    is attributed to each class by its share of the static wire time and
+    EWMA-blended (weight ``blend`` x share) into the process-local
+    calibrated set. The blended factors flow through
+    :func:`overlap_factors` into every later :func:`estimate_step_seconds`
+    call — cold-start priors, admission, and the anytime solver all re-price
+    — and through :func:`overlap_factor_signature` into the profile-cache
+    fingerprint, so entries priced under the old factor set miss.
+
+    Returns the active factor set after calibration. Env pins still win.
+    """
+    n_points = 0
+    for task in tasks:
+        for strat in getattr(task, "strategies", {}).values():
+            if not getattr(strat, "_static_overlap", False):
+                continue
+            if getattr(strat, "static_prior", False):
+                continue  # prior still live: no measurement yet
+            comm_by_op = getattr(strat, "_static_comm_by_op", None) or {}
+            compute_s = getattr(strat, "_static_compute_s", None)
+            measured = float(getattr(strat, "per_batch_time", 0.0) or 0.0)
+            comm_total = sum(comm_by_op.values())
+            if compute_s is None or comm_total <= 0.0 or measured <= 0.0:
+                continue
+            hidden = min(
+                max((compute_s + comm_total - measured) / comm_total, 0.0),
+                1.0,
+            )
+            active = overlap_factors()
+            for op, s in comm_by_op.items():
+                w = min(max(blend, 0.0), 1.0) * (s / comm_total)
+                base = active.get(op, 0.0)
+                _calibrated_factors[op] = min(
+                    max((1.0 - w) * base + w * hidden, 0.0), 1.0
+                )
+            n_points += 1
+    if n_points:
+        log.info(
+            "shardflow prior: calibrated overlap factors from %d measured "
+            "point(s): %s", n_points, overlap_factor_signature(),
+        )
+    return overlap_factors()
+
+
+def reset_overlap_calibration() -> None:
+    """Drop calibrated deltas (tests; factor set reverts to defaults+env)."""
+    _calibrated_factors.clear()

@@ -64,6 +64,10 @@ class GPT2Config:
     seq_axis: Optional[str] = None
     seq_axis_size: int = 1
     seq_mode: str = "ring"  # "ring" | "ulysses"
+    # Double-buffer the ring's k/v neighbor hop: ship block s+1 while block
+    # s is still being folded (ops/ring.py overlap schedule; bit-identical
+    # output, only the hop's program order moves). Ring mode only.
+    seq_overlap: bool = False
     # Single-program attention implementation: "dense" (XLA einsums), "flash"
     # (fused Pallas kernel, ops/flash.py), or "auto" (flash wherever the
     # kernel can lower — measured on the v5e chip: 1.01x at seq 512, 1.42x at
@@ -315,7 +319,8 @@ class Block(nn.Module):
                 from saturn_tpu.ops.ring import ring_attention
 
                 attn = ring_attention(
-                    q, k, v, axis_name=cfg.seq_axis, axis_size=cfg.seq_axis_size
+                    q, k, v, axis_name=cfg.seq_axis,
+                    axis_size=cfg.seq_axis_size, overlap=cfg.seq_overlap,
                 )
         elif self._attention_impl() == "flash":
             from saturn_tpu.ops.flash import flash_attention

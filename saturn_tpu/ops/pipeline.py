@@ -44,10 +44,25 @@ from saturn_tpu.ops.shmap_compat import shard_map
 #: Version tag for the *set* of pipeline schedules this module implements.
 #: Folded into the profile-cache fingerprint so entries profiled before a
 #: schedule was added (or after its program changes) miss instead of serving
-#: stale GPipe-only timings.
-SCHEDULE_SET_VERSION = "gpipe+1f1b:v1"
+#: stale GPipe-only timings.  v2: double-buffered (overlap=True) variants of
+#: both schedules — hop latency H=2, ppermute issued before the tick's
+#: compute.
+SCHEDULE_SET_VERSION = "gpipe+1f1b:v2"
 
 PIPELINE_SCHEDULES = ("gpipe", "1f1b")
+
+
+def _hop_latency(overlap: bool) -> int:
+    """Ticks an activation spends in flight between neighbor stages.
+
+    Serial schedule: the hop is issued after the tick's compute and consumed
+    next tick (H=1).  Double-buffered: the hop is issued at the TOP of the
+    tick from the previous tick's output, so its DMA rides under this tick's
+    compute and the value lands one tick later (H=2).  Every schedule
+    quantity below is a function of H; H=1 reproduces the v1 programs
+    exactly.
+    """
+    return 2 if overlap else 1
 
 
 def schedule_signature() -> str:
@@ -55,7 +70,9 @@ def schedule_signature() -> str:
     return SCHEDULE_SET_VERSION
 
 
-def schedule_bubble_fraction(schedule: str, n_stages: int, n_microbatches: int) -> float:
+def schedule_bubble_fraction(
+    schedule: str, n_stages: int, n_microbatches: int, overlap: bool = False
+) -> float:
     """Analytic idle (ramp) fraction of one pipelined step, per stage.
 
     GPipe runs forwards and backwards as two separate M+S-1-tick waves, so a
@@ -65,27 +82,38 @@ def schedule_bubble_fraction(schedule: str, n_stages: int, n_microbatches: int) 
     2(S-1)/(2(M+2(S-1))) = (S-1)/(M+2(S-1)) — *smaller*, which is exactly
     why a 1F1B job leaves fewer gaps for a co-scheduled partner to fill
     (the solver's co-location term prices this, see ``solver/milp.py``).
+
+    ``overlap=True`` (hop latency H=2) deepens the ramp H-fold in ticks —
+    the price of double-buffering; what it buys (the hop leaving each tick's
+    critical path) is modeled by the per-op-class overlap factor in
+    ``analysis/shardflow/prior.py``, not here.
     """
     S, M = int(n_stages), int(n_microbatches)
     if S <= 1:
         return 0.0
+    H = _hop_latency(overlap)
     if schedule == "1f1b":
-        return (S - 1) / (M + 2 * (S - 1))
-    return (S - 1) / (M + S - 1)
+        return H * (S - 1) / (M + 2 * H * (S - 1))
+    return H * (S - 1) / (M + H * (S - 1))
 
 
-def stash_depth(n_stages: int, n_microbatches: int, schedule: str = "1f1b") -> int:
+def stash_depth(
+    n_stages: int, n_microbatches: int, schedule: str = "1f1b",
+    overlap: bool = False,
+) -> int:
     """In-flight forward-activation stash depth of the staged schedule.
 
-    A microbatch's stage input is stashed at its forward tick ``s + m`` and
-    freed at its backward tick ``m + C - s`` (C = 2(S-1) for 1F1B), so at
-    most ``C + 1 = 2S-1`` microbatches are live per stage — O(S), independent
-    of M.  The staged-GPipe ordering flushes all M forwards first, so its
-    stash is the full ``M`` — the memory cliff 1F1B exists to avoid.
+    A microbatch's stage input is stashed at its forward tick ``H·s + m``
+    and freed at its backward tick ``m + C2 + H(S-1-s)`` (C2 = H(S-1) for
+    1F1B), so at most ``C2 + H(S-1) + 1`` microbatches are live per stage —
+    O(S), independent of M.  The staged-GPipe ordering flushes all M
+    forwards first, so its stash is the full ``M`` — the memory cliff 1F1B
+    exists to avoid.  Serial (H=1) 1F1B: ``2S-1``.
     """
     S, M = int(n_stages), int(n_microbatches)
-    C = 2 * (S - 1) if schedule == "1f1b" else M + 2 * (S - 1)
-    return max(1, min(M, C + 1))
+    H = _hop_latency(overlap)
+    c2 = H * (S - 1) if schedule == "1f1b" else M + H * (S - 1)
+    return max(1, min(M, c2 + H * (S - 1) + 1))
 
 
 def balance_stages(costs: Sequence[float], n_stages: int) -> Tuple[int, ...]:
@@ -425,6 +453,7 @@ def staged_pipeline_loss_and_grads(
     stage_axis: str = "stage",
     stage_spans: Optional[Sequence[int]] = None,
     schedule: str = "1f1b",
+    overlap: bool = False,
 ):
     """(loss, grads) with an *explicitly staged* backward — 1F1B by default.
 
@@ -452,6 +481,20 @@ def staged_pipeline_loss_and_grads(
     bit-identical, which is what lets the trial runner pick the schedule on
     realized cost alone (``tests/test_pipeline.py`` proves it on a CPU mesh).
 
+    ``overlap=True`` double-buffers both hops: each tick FIRST issues the
+    ppermutes shipping the PREVIOUS tick's activation/cotangent (held in two
+    pending carry slots), then runs its forward/backward phases — the hop's
+    operands predate the tick's compute, so its DMA rides underneath it.
+    Index maps generalize with hop latency H (= 2 overlapped, 1 serial)::
+
+        forward  of microbatch m on stage s at tick  H·s + m
+        backward of microbatch m on stage s at tick  m + C2 + H(S-1-s)
+        C2 = H(S-1) (1f1b) | M + H(S-1) (gpipe);  wall M + C2 + H(S-1)
+
+    Per-microbatch jaxpr and per-stage accumulation order are unchanged, so
+    overlapped grads are bit-identical to serial (``tests/test_overlap.py``)
+    — the schedule only stretches the ramp by H.
+
     The backward phase recomputes the stage forward from a stashed stage
     *input* under ``jax.vjp`` (torchgpipe-style per-microbatch
     checkpointing): residency is the depth-``stash_depth(S, M, schedule)``
@@ -467,9 +510,10 @@ def staged_pipeline_loss_and_grads(
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if M < 1:
         raise ValueError(f"n_microbatches must be >= 1, got {M}")
-    C = 2 * (S - 1) if schedule == "1f1b" else M + 2 * (S - 1)
-    n_ticks = M + C
-    D = max(1, min(M, C + 1))
+    H = _hop_latency(overlap)
+    C2 = H * (S - 1) if schedule == "1f1b" else M + H * (S - 1)
+    n_ticks = M + C2 + H * (S - 1)
+    D = max(1, min(M, C2 + H * (S - 1) + 1))
 
     params, spans, n_max = _resolve_spans(params, block_key, S, stage_spans)
     run_stage = _make_stage_runner(block_fn, remat)
@@ -526,11 +570,13 @@ def staged_pipeline_loss_and_grads(
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
         bwd_perm = [(i, (i - 1) % S) for i in range(S)]
 
-        def tick(carry, t):
-            fwd_in, bwd_ct, stash, g_blocks, g_other, loss_acc = carry
-
-            # -- forward phase: stage idx runs microbatch t - idx --
-            mf = t - idx
+        def phases(t, fwd_in, bwd_ct, stash, g_blocks, g_other, loss_acc):
+            """One tick's forward + backward phases (hop-free).  Returns the
+            produced activation/cotangent for the schedule wrapper to ship.
+            Identical jaxpr per active microbatch for both hop latencies —
+            the bit-identity anchor."""
+            # -- forward phase: stage idx runs microbatch t - H*idx --
+            mf = t - H * idx
             act_f = jnp.logical_and(mf >= 0, mf < M)
             mf_c = jnp.clip(mf, 0, M - 1)
             tok_f = lax.dynamic_index_in_dim(tokens_r, mf_c, keepdims=False)
@@ -539,8 +585,9 @@ def staged_pipeline_loss_and_grads(
                 y, loss_m = mb_fn(blocks, other, fwd_in, tok_f)
                 # Stash the stage INPUT (not output): the backward phase
                 # recomputes this stage's forward from it under vjp.  Slot
-                # m % D is free by then — a microbatch is live for C-2s+1
-                # ticks, and D = min(M, C+1) covers the worst (stage-0) span.
+                # m % D is free by then — a microbatch is live for
+                # C2 + H(S-1) - 2Hs + 1 ticks, and D = min(M, C2 + H(S-1)+1)
+                # covers the worst (stage-0) span.
                 new_stash = lax.dynamic_update_index_in_dim(
                     stash, fwd_in, jnp.mod(mf_c, D), 0
                 )
@@ -552,8 +599,8 @@ def staged_pipeline_loss_and_grads(
             y, loss_m, stash = lax.cond(act_f, fwd_run, fwd_skip)
             loss_acc = loss_acc + loss_m
 
-            # -- backward phase: stage idx pulls microbatch t - C + idx --
-            mbk = t - C + idx
+            # -- backward phase: stage idx pulls mb t - C2 - H*(S-1-idx) --
+            mbk = t - C2 - H * (S - 1 - idx)
             act_b = jnp.logical_and(mbk >= 0, mbk < M)
             mb_c = jnp.clip(mbk, 0, M - 1)
             tok_b = lax.dynamic_index_in_dim(tokens_r, mb_c, keepdims=False)
@@ -580,27 +627,61 @@ def staged_pipeline_loss_and_grads(
                 return g_blocks, g_other, zero_act
 
             g_blocks, g_other, gx = lax.cond(act_b, bwd_run, bwd_skip)
+            return y, gx, stash, g_blocks, g_other, loss_acc
 
-            # Collective hops stay OUTSIDE the phase conds — every device
-            # executes both ppermutes every tick (cond branches must not
-            # diverge on collectives across the gang).
+        def tick(carry, t):
+            # Serial (H=1): compute, then hop — the produced activation and
+            # cotangent land on the neighbor for the NEXT tick.  Collective
+            # hops stay OUTSIDE the phase conds — every device executes both
+            # ppermutes every tick (cond branches must not diverge on
+            # collectives across the gang).
+            fwd_in, bwd_ct, stash, g_blocks, g_other, loss_acc = carry
+            y, gx, stash, g_blocks, g_other, loss_acc = phases(
+                t, fwd_in, bwd_ct, stash, g_blocks, g_other, loss_acc
+            )
             fwd_next = lax.ppermute(y, stage_axis, fwd_perm)
             bwd_next = lax.ppermute(gx, stage_axis, bwd_perm)
             return (
                 fwd_next, bwd_next, stash, g_blocks, g_other, loss_acc
             ), None
 
-        carry0 = (
-            zero_act,
-            zero_act,
-            jnp.zeros((D,) + act_shape, act_dtype),
+        def tick_overlapped(carry, t):
+            # Double-buffered (H=2): the hops shipping the PREVIOUS tick's
+            # activation/cotangent are issued at the TOP of the tick, before
+            # the phases — their operands predate this tick's compute, so
+            # the DMA rides underneath it and the hopped values are consumed
+            # on the neighbor NEXT tick (2-tick effective latency, hence the
+            # H=2 index maps).
+            (y_pend, fwd_in, gx_pend, bwd_ct, stash,
+             g_blocks, g_other, loss_acc) = carry
+            fwd_next = lax.ppermute(y_pend, stage_axis, fwd_perm)
+            bwd_next = lax.ppermute(gx_pend, stage_axis, bwd_perm)
+            y, gx, stash, g_blocks, g_other, loss_acc = phases(
+                t, fwd_in, bwd_ct, stash, g_blocks, g_other, loss_acc
+            )
+            return (
+                y, fwd_next, gx, bwd_next, stash,
+                g_blocks, g_other, loss_acc,
+            ), None
+
+        stash0 = jnp.zeros((D,) + act_shape, act_dtype)
+        g0 = (
             jax.tree.map(jnp.zeros_like, blocks),
             jax.tree.map(jnp.zeros_like, other),
-            zero_loss,
         )
-        (_, _, _, g_blocks, g_other, loss_acc), _ = lax.scan(
-            tick, carry0, jnp.arange(n_ticks)
-        )
+        if overlap:
+            carry0 = (
+                zero_act, zero_act, zero_act, zero_act, stash0,
+                g0[0], g0[1], zero_loss,
+            )
+            (_, _, _, _, _, g_blocks, g_other, loss_acc), _ = lax.scan(
+                tick_overlapped, carry0, jnp.arange(n_ticks)
+            )
+        else:
+            carry0 = (zero_act, zero_act, stash0, g0[0], g0[1], zero_loss)
+            (_, _, _, g_blocks, g_other, loss_acc), _ = lax.scan(
+                tick, carry0, jnp.arange(n_ticks)
+            )
 
         # loss_acc is nonzero only on the last stage; each loss_m is a
         # per-microbatch mean, so /M matches the dense/GPipe convention.

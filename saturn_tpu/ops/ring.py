@@ -42,6 +42,7 @@ def ring_attention(
     axis_name: str,
     axis_size: int,
     causal: bool = True,
+    overlap: bool = False,
 ) -> jax.Array:
     """Blockwise causal attention over a sharded sequence axis.
 
@@ -49,6 +50,13 @@ def ring_attention(
     chunks, shape (B, H, Tc, D) with Tc = T / axis_size; returns the local
     (B, H, Tc, D) attention output. fp32 softmax accumulation; matmuls feed
     the MXU in the input dtype with fp32 accumulation.
+
+    ``overlap=True`` double-buffers the neighbor hop: the scan body issues
+    the ``ppermute`` shipping block s+1 BEFORE folding block s, so the hop's
+    DMA is in flight while the MXU chews the current block. Same values
+    through the same accumulate ops in the same order — bit-identical to the
+    serial schedule (asserted by tests/test_overlap.py) — only the program
+    order of the hop changes, which is what the TPU scheduler keys on.
     """
     B, H, Tc, D = q.shape
     idx = lax.axis_index(axis_name)
@@ -95,12 +103,23 @@ def ring_attention(
         kc, vc = lax.ppermute((kc, vc), axis_name, perm)
         return (o, l, m, kc, vc), None
 
+    def step_overlapped(carry, s):
+        # Hop first: ship block s+1 while block s is still being folded.
+        # The ppermute's operands come straight from the carry, so it has no
+        # data dependence on this step's accumulate.
+        o, l, m, kc, vc = carry
+        kc_next, vc_next = lax.ppermute((kc, vc), axis_name, perm)
+        o, l, m = accumulate(o, l, m, kc, vc, s)
+        return (o, l, m, kc_next, vc_next), None
+
     # S-1 (accumulate, rotate) steps in the scan; the final block is folded
     # outside it so no dead ppermute ships k/v nobody reads.
     o, l, m, kc, vc = o0, l0, m0, k, v
     if axis_size > 1:
         (o, l, m, kc, vc), _ = lax.scan(
-            step, (o, l, m, kc, vc), jnp.arange(axis_size - 1)
+            step_overlapped if overlap else step,
+            (o, l, m, kc, vc),
+            jnp.arange(axis_size - 1),
         )
     o, l, _ = accumulate(o, l, m, kc, vc, axis_size - 1)
     out = o / jnp.where(l == 0.0, 1.0, l)[..., None]

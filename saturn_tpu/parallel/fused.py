@@ -136,8 +136,20 @@ def fusion_candidates(
     by_fp: Dict[str, List[str]] = {}
     for t in task_list:
         fp = fusion_fingerprint(t)
-        if fp is not None:
-            by_fp.setdefault(fp, []).append(t.name)
+        if fp is None:
+            continue
+        # The stacked program runs every member as a whole-model replica on
+        # its model-axis shard — one chip, full batch, no data-axis psum. A
+        # task whose allowed solo widths include >1 chip would see different
+        # floating-point arithmetic (split batch + cross-chip grad reduce)
+        # depending on whether the scheduler happened to fuse it, breaking
+        # trajectory bit-identity under rescheduling (tests/test_chaos.py
+        # compares faulted campaigns against an uninterrupted reference).
+        # Only single-chip tasks are arithmetic-neutral to fuse.
+        widths = getattr(t, "chip_range", None) or []
+        if any(int(c) != 1 for c in widths):
+            continue
+        by_fp.setdefault(fp, []).append(t.name)
     groups: List[List[str]] = []
     for names in by_fp.values():
         for i in range(0, len(names), max(int(max_members), 2)):
@@ -735,10 +747,23 @@ def run_fused_interval(
     faulted: set = set()
     for m in cur:
         col = columns.get(m.name) or []
+        poison = m.__dict__.pop("_health_poison", None)
         if not col:
             continue
         vec = jnp.concatenate(col)
         if scfg.enabled:
+            if poison is not None:
+                # Chaos injection corrupts the OBSERVED member column only
+                # (train state untouched), exactly like the solo path
+                # (spmd_base interval finalization) — without this, faults
+                # scheduled onto a fused member were silently dropped and
+                # the chaos campaign never saw a rollback.
+                ov = _sentinel.poison_overrides(
+                    poison, int(vec.shape[0]),
+                    lambda j: m.dataset_index(starts[m.name] + j),
+                )
+                if ov is not None:
+                    vec = vec.at[ov[0]].set(ov[1])
             carry = getattr(m, "_sentinel_carry", None)
             if carry is None:
                 carry = _sentinel.carry_init()

@@ -161,6 +161,12 @@ class Pipeline(SPMDTechnique):
                             base["layout"] = layout
                         grid.append(dict(base, remat=False))
                         grid.append(dict(base, remat=True))
+                        # Double-buffered stage hops (ops/pipeline.py H=2):
+                        # next tick's ppermute issued before this tick's
+                        # stage compute. Own grid points — realized cost
+                        # decides, the bubble model prices H into the prior.
+                        grid.append(dict(base, remat=False, overlap=True))
+                        grid.append(dict(base, remat=True, overlap=True))
         return grid
 
     def config_bubble_fraction(self, config) -> float:
@@ -172,7 +178,8 @@ class Pipeline(SPMDTechnique):
         s = int(config.get("stages", 2))
         m = int(config.get("microbatches", 2 * s))
         return schedule_bubble_fraction(
-            str(config.get("schedule", "gpipe")), s, m
+            str(config.get("schedule", "gpipe")), s, m,
+            overlap=bool(config.get("overlap", False)),
         )
 
     def make_step_fns(self, spec, task, config, mesh, ds):
@@ -207,14 +214,18 @@ class Pipeline(SPMDTechnique):
             stage_spans=spans,
         )
 
-        if schedule == "1f1b":
-            # Explicitly staged 1F1B: bounded stash (min(M, 2S-1) vs AD's M
-            # live microbatch residuals), backward launched C=2(S-1) ticks
-            # behind forward. Bit-identical summed grads vs the staged
-            # GPipe ordering (same body jaxpr, same accumulation order).
+        overlap = bool(config.get("overlap", False))
+        if schedule == "1f1b" or overlap:
+            # Explicitly staged program: bounded stash (min(M, 2S-1) vs AD's
+            # M live microbatch residuals), backward launched C2 ticks behind
+            # forward. Bit-identical summed grads vs the staged GPipe
+            # ordering (same body jaxpr, same accumulation order). Overlapped
+            # GPipe also routes here — only the staged scan can hoist the
+            # stage hop above the tick's compute (H=2 double buffering).
             def loss_and_grads(params, batch):
                 return staged_pipeline_loss_and_grads(
-                    params, batch, schedule="1f1b", **common
+                    params, batch, schedule=schedule, overlap=overlap,
+                    **common
                 )
         else:
             def loss_and_grads(params, batch):

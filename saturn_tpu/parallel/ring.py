@@ -62,8 +62,12 @@ class RingSequenceParallel(SPMDTechnique):
         sp = 2
         while sp <= n_devices and T % sp == 0:
             if ds.batch_size % (n_devices // sp) == 0:
+                # overlap = double-buffered k/v hop (ops/ring.py): profiled
+                # as its own grid point so realized cost, not faith, picks.
                 grid.append({"sp": sp, "remat": False})
+                grid.append({"sp": sp, "remat": False, "overlap": True})
                 grid.append({"sp": sp, "remat": True})
+                grid.append({"sp": sp, "remat": True, "overlap": True})
             sp <<= 1
         return grid
 
@@ -71,6 +75,7 @@ class RingSequenceParallel(SPMDTechnique):
         out = super()._model_overrides(config)
         out["seq_axis"] = "seq"
         out["seq_axis_size"] = config.get("sp", 2)
+        out["seq_overlap"] = bool(config.get("overlap", False))
         return out
 
     def make_step_fns(self, spec, task, config, mesh, ds):

@@ -420,6 +420,12 @@ class AdmissionController:
             from saturn_tpu.analysis.shardflow import prior as sf_prior
 
             diags = sf_prior.audit_task(task)
+            # Same audit stream, second consumer: measured step times on
+            # formerly-overlapped priors move the per-op-class overlap
+            # factors, so the next cold-start/admission/solver pass prices
+            # overlap from evidence instead of the static seeds. Warn-only
+            # path — a calibration failure must never gate admission.
+            sf_prior.calibrate_overlap_factors([task])
         except Exception:
             return
         for d in diags:

@@ -207,6 +207,34 @@ def fusion_signature() -> str:
         return "no-fusion"
 
 
+def overlap_signature() -> str:
+    """Overlap lowering version + active per-op-class factor set.
+
+    Two reasons an entry must MISS: (1) the overlapped programs changed
+    shape (``ops/collective_matmul.OVERLAP_SET_VERSION`` — a serial profile
+    must never price an overlapped lowering, and vice versa); (2) the
+    overlap factors the prior priced it under moved (calibration or an env
+    pin), so a plan warm-started from the entry would disagree with what
+    admission and the solver now compute. Lazy imports like the signatures
+    above: utils must not import ops/analysis at module level.
+    """
+    try:
+        from saturn_tpu.ops.collective_matmul import overlap_signature as _os
+
+        lowering = _os()
+    except Exception:
+        lowering = "no-overlap"
+    try:
+        from saturn_tpu.analysis.shardflow.prior import (
+            overlap_factor_signature as _ofs,
+        )
+
+        factors = _ofs()
+    except Exception:
+        factors = "no-factors"
+    return f"{lowering};{factors}"
+
+
 def fingerprint(
     task_sig: str, technique: str, size: int, topo_sig: str,
     dispatch: Optional[str] = None,
@@ -250,6 +278,10 @@ def fingerprint(
             # Fusion-set version: entries recorded before cross-job stacking
             # existed (or under a different stacked-step program) must miss.
             "fusion": fusion_signature(),
+            # Overlap lowering version + active overlap-factor set: serial
+            # profiles must not price overlapped programs, and recalibrated
+            # factors must invalidate plans priced under the old set.
+            "overlap": overlap_signature(),
         },
         sort_keys=True,
     )

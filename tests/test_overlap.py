@@ -1,0 +1,379 @@
+"""Comm/compute overlap: the knob must change schedules, never values.
+
+Every overlapped lowering in the repo is gated behind a config knob and
+claims a numerical contract against its serial twin:
+
+* ring attention ``overlap`` and the staged pipeline ``overlap`` —
+  **bit-identical** (same accumulate ops in the same order; only the hop's
+  program position moves);
+* ZeRO-3 ``prefetch`` — **bit-identical** (gathers are pure data movement);
+* the interleaved collective matmul — **allclose** only (the chunked
+  accumulation reassociates the contraction).
+
+Plus the solver side of the tentpole: the per-op-class overlap factors
+must re-price overlapped grid points below their serial pricing, the
+SAT-X005 audit stream must calibrate them, and the profile-cache
+fingerprint must miss when the factor set (or the lowering version)
+moves — a serial profile must never warm-start an overlapped program.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from saturn_tpu.ops.shmap_compat import shard_map
+from tests.test_pipeline import (
+    _assert_bitwise_equal,
+    _assert_close,
+    _toy_pipeline,
+)
+
+pytestmark = pytest.mark.overlap
+
+
+# --------------------------------------------------------------- ring hops
+class TestRingOverlap:
+    def _run(self, overlap, q, k, v, mesh, grads=False):
+        from saturn_tpu.ops.ring import ring_attention
+
+        def f(qq, kk, vv):
+            return ring_attention(
+                qq, kk, vv, axis_name="seq", axis_size=4, overlap=overlap
+            )
+
+        sm = shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, None, "seq", None),) * 3,
+            out_specs=P(None, None, "seq", None),
+        )
+        if not grads:
+            return jax.jit(sm)(q, k, v)
+
+        def loss(qq, kk, vv):
+            return jnp.mean(sm(qq, kk, vv) ** 2)
+
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    @pytest.fixture()
+    def qkv_mesh(self, devices8):
+        mesh = Mesh(np.array(devices8[:4]).reshape(1, 4), ("data", "seq"))
+        B, H, T, D = 2, 2, 32, 8
+        keys = jax.random.split(jax.random.PRNGKey(1), 3)
+        q, k, v = (jax.random.normal(kk, (B, H, T, D)) for kk in keys)
+        return q, k, v, mesh
+
+    def test_forward_bit_identical(self, qkv_mesh):
+        q, k, v, mesh = qkv_mesh
+        o_serial = self._run(False, q, k, v, mesh)
+        o_overlap = self._run(True, q, k, v, mesh)
+        _assert_bitwise_equal(o_serial, o_overlap)
+
+    def test_grads_bit_identical(self, qkv_mesh):
+        q, k, v, mesh = qkv_mesh
+        g_serial = self._run(False, q, k, v, mesh, grads=True)
+        g_overlap = self._run(True, q, k, v, mesh, grads=True)
+        _assert_bitwise_equal(g_serial, g_overlap)
+
+
+# ---------------------------------------------------------- pipeline hops
+class TestPipelineOverlap:
+    @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+    @pytest.mark.parametrize("remat", [False, True])
+    def test_even_spans_bit_identical(self, devices8, schedule, remat):
+        from saturn_tpu.ops.pipeline import staged_pipeline_loss_and_grads
+
+        params, tokens, fns, dense_loss = _toy_pipeline(d=2)
+
+        def run(overlap):
+            f = jax.jit(lambda p, t: staged_pipeline_loss_and_grads(
+                p, t, n_microbatches=4, schedule=schedule, remat=remat,
+                overlap=overlap, **fns))
+            return f(params, tokens)
+
+        l_serial, g_serial = run(False)
+        l_overlap, g_overlap = run(True)
+        assert float(jax.device_get(l_serial)) == float(
+            jax.device_get(l_overlap))
+        _assert_bitwise_equal(g_serial, g_overlap)
+        # and both still match the dense model (the knob didn't detach
+        # the program from the reference arithmetic, just reorder hops)
+        _, g_ref = jax.value_and_grad(dense_loss)(params, tokens)
+        _assert_close(g_overlap, g_ref, atol=1e-6)
+
+    def test_uneven_spans_bit_identical(self, devices8):
+        from saturn_tpu.ops.pipeline import (
+            balance_stages,
+            staged_pipeline_loss_and_grads,
+        )
+
+        params, tokens, fns, _ = _toy_pipeline(L=6, d=2)
+        spans = balance_stages([1.0, 3.0, 1.0, 1.0, 1.0, 1.0], 4)
+        assert max(spans) > min(spans)  # genuinely uneven
+
+        def run(overlap):
+            f = jax.jit(lambda p, t: staged_pipeline_loss_and_grads(
+                p, t, n_microbatches=4, schedule="1f1b",
+                stage_spans=spans, overlap=overlap, **fns))
+            return f(params, tokens)
+
+        l_serial, g_serial = run(False)
+        l_overlap, g_overlap = run(True)
+        assert float(jax.device_get(l_serial)) == float(
+            jax.device_get(l_overlap))
+        _assert_bitwise_equal(g_serial, g_overlap)
+
+
+# --------------------------------------------------- collective matmul
+class TestCollectiveMatmul:
+    def test_ring_all_gather_matches_tiled(self, devices8):
+        from saturn_tpu.ops.collective_matmul import ring_all_gather
+
+        mesh = Mesh(np.array(devices8[:4]), ("data",))
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 6))
+
+        def f(xs):
+            return ring_all_gather(xs, axis_name="data", axis_size=4, axis=0)
+
+        sm = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(),
+                       check_vma=False)
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(sm)(x)), np.asarray(x))
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_allgather_matmul_matches_plain(self, devices8, overlap):
+        """Both forms vs the unsharded dot_general. The serial form chains
+        the hops then contracts once; the overlapped form reassociates —
+        allclose is the contract, bitwise is not claimed."""
+        from saturn_tpu.ops.collective_matmul import allgather_matmul
+
+        mesh = Mesh(np.array(devices8[:4]), ("data",))
+        K, N, B = 16, 10, 5
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, K))
+        w = jax.random.normal(jax.random.PRNGKey(2), (K, N))
+
+        def f(w_shard):
+            return allgather_matmul(
+                x, w_shard, axis_name="data", axis_size=4, overlap=overlap
+            )
+
+        sm = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(),
+                       check_vma=False)
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(sm)(w)), np.asarray(x @ w),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+# -------------------------------------------------------- zero3 prefetch
+def _zero3_toy():
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    L, DM, V, B, T = 4, 16, 31, 8, 12
+    params = {
+        "emb": jax.random.normal(k1, (V, DM)) * 0.02,
+        "blocks": {
+            "w": jax.random.normal(k2, (L, DM, DM)) * 0.1,
+            "b": jnp.zeros((L, DM)),
+        },
+        "head": jax.random.normal(k3, (DM, V)) * 0.02,
+    }
+    tokens = jax.random.randint(k4, (B, T), 0, V)
+    fns = dict(
+        embed_fn=lambda other, tok: other["emb"][tok],
+        block_fn=lambda lp, h: jnp.tanh(h @ lp["w"] + lp["b"]),
+        head_fn=lambda other, h: h @ other["head"],
+        loss_fn=lambda logits, tok: -jnp.mean(
+            jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1), tok[..., None], axis=-1
+            )
+        ),
+    )
+
+    def dense_loss(p, tok):
+        h = fns["embed_fn"](p, tok)
+        h, _ = jax.lax.scan(
+            lambda hh, lp: (fns["block_fn"](lp, hh), None), h, p["blocks"])
+        return fns["loss_fn"](fns["head_fn"](p, h), tok)
+
+    return params, tokens, fns, dense_loss
+
+
+class TestZero3Prefetch:
+    @pytest.mark.parametrize("remat", [False, True])
+    def test_prefetch_bit_identical_and_matches_dense(self, devices8, remat):
+        from saturn_tpu.ops.collective_matmul import zero3_loss_and_grads
+
+        params, tokens, fns, dense_loss = _zero3_toy()
+        mesh = Mesh(np.array(devices8[:4]), ("data",))
+
+        def run(prefetch):
+            f = jax.jit(lambda p, t: zero3_loss_and_grads(
+                p, t, mesh=mesh, block_key="blocks", shard_axis="data",
+                prefetch=prefetch, remat=remat, min_size=1, **fns))
+            return f(params, tokens)
+
+        l_serial, g_serial = run(False)
+        l_prefetch, g_prefetch = run(True)
+        assert float(jax.device_get(l_serial)) == float(
+            jax.device_get(l_prefetch))
+        _assert_bitwise_equal(g_serial, g_prefetch)
+        l_ref, g_ref = jax.value_and_grad(dense_loss)(params, tokens)
+        assert float(l_prefetch) == pytest.approx(float(l_ref), abs=1e-5)
+        _assert_close(g_prefetch, g_ref, atol=1e-4)
+
+    def test_tp_form_matches_dense(self, devices8):
+        """The (data, model) weight-gathered lowering tp reuses: batch over
+        both axes, shards over 'model' — grads must still match dense."""
+        from saturn_tpu.ops.collective_matmul import zero3_loss_and_grads
+
+        params, tokens, fns, dense_loss = _zero3_toy()
+        mesh = Mesh(np.array(devices8).reshape(2, 4), ("data", "model"))
+        f = jax.jit(lambda p, t: zero3_loss_and_grads(
+            p, t, mesh=mesh, block_key="blocks", shard_axis="model",
+            batch_axes=("data", "model"), prefetch=True, min_size=1, **fns))
+        loss, grads = f(params, tokens)
+        l_ref, g_ref = jax.value_and_grad(dense_loss)(params, tokens)
+        assert float(loss) == pytest.approx(float(l_ref), abs=1e-5)
+        _assert_close(grads, g_ref, atol=1e-4)
+
+
+# ------------------------------------------------- solver repricing
+class TestOverlapPricing:
+    def _toy_ledger(self):
+        from saturn_tpu.analysis.shardflow.interp import (
+            CollectiveRecord, CommLedger,
+        )
+
+        led = CommLedger(flops=4e12)
+        led.add(CollectiveRecord(
+            op="all_gather", axes=("data",), bytes=10**8, wire_bytes=2e8,
+            count=4, primitive="all_gather", provenance="t"))
+        led.add(CollectiveRecord(
+            op="all_reduce", axes=("data",), bytes=10**8, wire_bytes=1e8,
+            count=1, primitive="psum", provenance="t"))
+        return led
+
+    def test_overlapped_estimate_below_serial(self):
+        from saturn_tpu.analysis.shardflow import prior
+
+        led = self._toy_ledger()
+        serial = prior.estimate_step_seconds(led, 4)
+        overlapped = prior.estimate_step_seconds(led, 4, overlap=True)
+        assert overlapped < serial
+        # all_reduce carries factor 0: only the gather discount applies
+        by_op = prior.comm_seconds_by_op(led)
+        f = prior.overlap_factors()
+        expected = serial - by_op["all_gather"] * f["all_gather"]
+        assert overlapped == pytest.approx(expected, rel=1e-9)
+
+    def test_prior_reprices_overlapped_technique(self, tiny_task, devices8):
+        """The admission-path pricing: trace the fsdp overlap grid point
+        through shardflow and the overlap factors must price it strictly
+        below the same ledger priced serial."""
+        from saturn_tpu.analysis.shardflow.interp import interpret
+        from saturn_tpu.analysis.shardflow import prior
+        from saturn_tpu.parallel.fsdp import FSDP
+
+        tech = FSDP()
+        config = next(c for c in tech.candidate_configs(tiny_task, 4)
+                      if c.get("overlap"))
+        traced = tech.trace_step(tiny_task, devices8[:4], config)
+        ledger = interpret(traced)
+        serial = prior.estimate_step_seconds(ledger, 4, overlap=False)
+        overlapped = prior.estimate_step_seconds(ledger, 4, overlap=True)
+        assert overlapped < serial
+
+    def test_calibration_moves_factors_and_repricing(self):
+        """A measured step faster than the serial static estimate raises
+        the gather factor, and the next estimate drops accordingly."""
+        from saturn_tpu.analysis.shardflow import prior
+
+        led = self._toy_ledger()
+        by_op = prior.comm_seconds_by_op(led)
+        serial = prior.estimate_step_seconds(led, 4)
+        compute_s = serial - sum(by_op.values())
+
+        class _Strat:
+            pass
+
+        class _Task:
+            pass
+
+        strat = _Strat()
+        strat._static_overlap = True
+        strat.static_prior = False  # measurement landed
+        strat._static_comm_by_op = by_op
+        strat._static_compute_s = compute_s
+        # measured: the gather fully hidden, the all_reduce still paid
+        strat.per_batch_time = compute_s + by_op["all_reduce"]
+        task = _Task()
+        task.strategies = {4: strat}
+
+        prior.reset_overlap_calibration()
+        try:
+            before_f = prior.overlap_factors()["all_gather"]
+            before_t = prior.estimate_step_seconds(led, 4, overlap=True)
+            after = prior.calibrate_overlap_factors([task])
+            assert after["all_gather"] > before_f
+            after_t = prior.estimate_step_seconds(led, 4, overlap=True)
+            assert after_t < before_t
+        finally:
+            prior.reset_overlap_calibration()
+
+    def test_synthesize_stashes_calibration_inputs(self, tiny_task,
+                                                   devices8):
+        """Cold-start strategies carry the static decomposition the
+        calibrator needs once a measurement supersedes them."""
+        from saturn_tpu.analysis.shardflow import prior
+        from saturn_tpu.core.mesh import SliceTopology
+
+        topo = SliceTopology(devices8)
+        added = prior.synthesize_strategies(
+            tiny_task, topo, technique_names=["fsdp"])
+        assert added
+        strat = tiny_task.strategies[added[0]]
+        assert hasattr(strat, "_static_overlap")
+        assert isinstance(strat._static_comm_by_op, dict)
+        assert strat._static_compute_s >= 0.0
+
+
+# ------------------------------------------------- fingerprint identity
+class TestOverlapFingerprint:
+    def test_factor_change_misses(self, monkeypatch):
+        """A profile priced under one factor set must not warm-start a run
+        under another: env-pinning one factor changes every fingerprint."""
+        from saturn_tpu.utils import profile_cache as pc
+
+        base = pc.fingerprint("task", "fsdp", 4, "topo")
+        monkeypatch.setenv("SATURN_TPU_PRIOR_OVERLAP_ALL_GATHER", "0.95")
+        pinned = pc.fingerprint("task", "fsdp", 4, "topo")
+        assert pinned != base
+        monkeypatch.delenv("SATURN_TPU_PRIOR_OVERLAP_ALL_GATHER")
+        assert pc.fingerprint("task", "fsdp", 4, "topo") == base
+
+    def test_lowering_version_in_signature(self):
+        from saturn_tpu.ops.collective_matmul import OVERLAP_SET_VERSION
+        from saturn_tpu.utils import profile_cache as pc
+
+        sig = pc.overlap_signature()
+        assert f"comm-overlap-v{OVERLAP_SET_VERSION}" in sig
+        # and the active factor set rides along
+        assert "all_gather=" in sig
+
+    def test_calibration_misses(self):
+        """Recalibrated factors invalidate cache entries priced under the
+        old set — the stale-serial-profile guarantee of the tentpole."""
+        from saturn_tpu.analysis.shardflow import prior
+        from saturn_tpu.utils import profile_cache as pc
+
+        prior.reset_overlap_calibration()
+        try:
+            base = pc.fingerprint("task", "fsdp", 4, "topo")
+            prior._calibrated_factors["all_gather"] = 0.91
+            assert pc.fingerprint("task", "fsdp", 4, "topo") != base
+        finally:
+            prior.reset_overlap_calibration()
+        assert pc.fingerprint("task", "fsdp", 4, "topo") == base

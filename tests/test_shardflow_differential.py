@@ -57,6 +57,18 @@ SIGNATURE_RATIO = (0.5, 2.0)
 TECHNIQUES = ["dp", "fsdp", "tp", "ep", "ring", "ulysses"]
 SIGNATURES = {"ring": "ppermute", "ulysses": "all_to_all"}
 
+#: Bands for the overlapped (collective-matmul / ZeRO-3 prefetch) grid
+#: points, wider on top than TOTAL_RATIO for two *legal* deflations of the
+#: HLO side: (1) the static ledger folds scan trip counts (xL gathers in
+#: the layer loop) while the optimized-HLO text lists each while-body
+#: instruction once; (2) the collective-permute combiner merges per-leaf
+#: hop chains. Both grow with the gather ring size — calibrated on this
+#: image: fsdp (S=4) total 3.4 / ppermute 4.5, tp (S=2) total 1.3 /
+#: ppermute 1.5. The floor still catches a propagation rule that loses
+#: whole tensors; the ceiling catches invented ones beyond the fold.
+OVERLAP_TOTAL_RATIO = (0.45, 4.5)
+OVERLAP_PPERMUTE_RATIO = (0.5, 6.0)
+
 # --------------------------------------------------------------------------
 # HLO collective extraction
 # --------------------------------------------------------------------------
@@ -198,6 +210,67 @@ def test_static_ledger_matches_compiled_collectives(
             f"{name}: {sig} bytes static {by[sig]['bytes']} vs compiled "
             f"{hlo[sig]['bytes']} (ratio {sig_ratio:.2f})"
         )
+
+
+@pytest.mark.parametrize("name", ["fsdp", "tp"])
+def test_overlapped_lowering_ledger_matches_compiled(
+        name, tiny_task, devices8):
+    """The collective-matmul / ZeRO-3 prefetch grid points trace to an
+    explicit shard_map program (ring gathers as ppermute chains instead of
+    GSPMD's inferred all-gathers). The static ledger must still track the
+    compiled bytes, and the signature op — ppermute — must appear on both
+    sides: the overlapped lowering gets the same differential gate as the
+    serial techniques, not a free pass."""
+    tech = _technique(name)
+    configs = [c for c in tech.candidate_configs(tiny_task, SIZE)
+               if c.get("overlap")]
+    assert configs, f"{name}: no overlap grid point for the tiny task"
+    config = configs[0]
+
+    devices = devices8[:SIZE]
+    traced = tech.trace_step(tiny_task, devices, config)
+    ledger = interpret(traced)
+
+    axis_names, axis_sizes = tech.mesh_spec(SIZE, tiny_task, config)
+    mesh = make_submesh(devices, axis_names, axis_sizes)
+    spec = tiny_task.get_model(**tech._model_overrides(config))
+    ds = tiny_task.get_dataset()
+    _, train_step = tech.make_step_fns(spec, tiny_task, config, mesh, ds)
+    state_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s if s is not None else PartitionSpec()),
+        traced["state_specs"],
+        is_leaf=lambda x: x is None or isinstance(x, PartitionSpec),
+    )
+    batch_sh = NamedSharding(mesh, traced["batch_spec"])
+    compiled = (
+        jax.jit(train_step, in_shardings=(state_sh, batch_sh))
+        .lower(traced["state_shapes"], traced["batch_sds"])
+        .compile()
+    )
+    hlo = hlo_collectives(compiled.as_text())
+
+    assert ledger.records, f"{name}+overlap: static ledger is empty"
+    assert hlo, f"{name}+overlap: compiled program has no collectives"
+    static_total = ledger.total_bytes()
+    hlo_total = sum(row["bytes"] for row in hlo.values())
+    ratio = static_total / hlo_total
+    lo, hi = OVERLAP_TOTAL_RATIO
+    assert lo <= ratio <= hi, (
+        f"{name}+overlap: static {static_total}B vs compiled {hlo_total}B "
+        f"(ratio {ratio:.2f} outside [{lo}, {hi}]) — "
+        f"static={ledger.by_op()} hlo={hlo}"
+    )
+    by = ledger.by_op()
+    assert "ppermute" in by, (
+        f"{name}+overlap: static ledger lost the ring-gather hops: {by}")
+    assert "ppermute" in hlo, (
+        f"{name}+overlap: compiled HLO lost the ring-gather hops: {hlo}")
+    sig_ratio = by["ppermute"]["bytes"] / hlo["ppermute"]["bytes"]
+    slo, shi = OVERLAP_PPERMUTE_RATIO
+    assert slo <= sig_ratio <= shi, (
+        f"{name}+overlap: ppermute bytes static {by['ppermute']['bytes']} "
+        f"vs compiled {hlo['ppermute']['bytes']} (ratio {sig_ratio:.2f})"
+    )
 
 
 def test_dense_techniques_agree_on_flops(tiny_task, devices8):
